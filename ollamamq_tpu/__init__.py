@@ -1,0 +1,15 @@
+"""ollamamq_tpu — a TPU-native LLM serving framework.
+
+A brand-new framework with the capabilities of Chleba/ollamaMQ (per-user FIFO
+queuing, fair-share scheduling with VIP/Boost, model-aware routing, dual
+Ollama `/api/*` + OpenAI `/v1/*` API surfaces, streaming, health monitoring,
+user/IP blocking, admin TUI) — but the pool of HTTP-proxied backends is
+replaced by an in-tree JAX/XLA continuous-batching inference engine running
+on TPU: prefill + paged-KV decode, tensor-parallel collectives over ICI,
+a token-level batch scheduler fed by the per-user fair-share queues.
+
+Reference capability map: /root/reference/src/{main,dispatcher,tui}.rs
+(studied for behavior only; architecture here is TPU-first).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,165 @@
+"""Model and engine configuration.
+
+Model architecture configs for the families the framework serves natively:
+Llama 3.x (incl. llama3.2:1b and Llama-3-8B) and Qwen2.5 (attention bias),
+plus a bidirectional encoder config for embedding models (nomic-embed-text
+class). These are the model names the reference's stress test exercises
+(/root/reference/test_dispatcher.sh:5-7) and BASELINE.json's configs list.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Decoder-only transformer architecture description (Llama/Qwen family)."""
+
+    name: str
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    rope_theta: float = 500_000.0
+    rms_norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    tie_embeddings: bool = False
+    # Qwen2-style attention projections carry a bias term; Llama's do not.
+    attn_bias: bool = False
+    # Bidirectional attention + mean pooling => embedding encoder, not a LM.
+    is_encoder: bool = False
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    def param_count(self) -> int:
+        """Approximate parameter count (for HBM budgeting)."""
+        d, f, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        per_layer = (
+            d * self.q_dim + 2 * d * self.kv_dim + self.q_dim * d  # attn
+            + 3 * d * f  # swiglu mlp
+            + 2 * d  # norms
+        )
+        embed = v * d * (1 if self.tie_embeddings else 2)
+        return self.num_layers * per_layer + embed + d
+
+
+# ---------------------------------------------------------------------------
+# Architecture registry. Sizes follow the public architecture descriptions of
+# each family; "test" configs are tiny and used by the unit-test suite.
+# ---------------------------------------------------------------------------
+
+MODEL_CONFIGS = {
+    # Tiny config for tests — runs on CPU in milliseconds.
+    "test-tiny": ModelConfig(
+        name="test-tiny", vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        rope_theta=10_000.0, max_seq_len=512,
+    ),
+    "test-tiny-qwen": ModelConfig(
+        name="test-tiny-qwen", vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        rope_theta=10_000.0, max_seq_len=512, attn_bias=True,
+    ),
+    "llama3.2:1b": ModelConfig(
+        name="llama3.2:1b", vocab_size=128_256, hidden_size=2048,
+        intermediate_size=8192, num_layers=16, num_heads=32, num_kv_heads=8,
+        head_dim=64, rope_theta=500_000.0, max_seq_len=131_072,
+        tie_embeddings=True,
+    ),
+    "llama3.2:3b": ModelConfig(
+        name="llama3.2:3b", vocab_size=128_256, hidden_size=3072,
+        intermediate_size=8192, num_layers=28, num_heads=24, num_kv_heads=8,
+        head_dim=128, rope_theta=500_000.0, max_seq_len=131_072,
+        tie_embeddings=True,
+    ),
+    "llama3:8b": ModelConfig(
+        name="llama3:8b", vocab_size=128_256, hidden_size=4096,
+        intermediate_size=14_336, num_layers=32, num_heads=32, num_kv_heads=8,
+        head_dim=128, rope_theta=500_000.0, max_seq_len=8192,
+    ),
+    "qwen2.5:7b": ModelConfig(
+        name="qwen2.5:7b", vocab_size=152_064, hidden_size=3584,
+        intermediate_size=18_944, num_layers=28, num_heads=28, num_kv_heads=4,
+        head_dim=128, rope_theta=1_000_000.0, max_seq_len=32_768,
+        attn_bias=True,
+    ),
+    "qwen2.5-7b-instruct": ModelConfig(  # LM-Studio style alias used in the
+        name="qwen2.5-7b-instruct",      # reference stress test
+        vocab_size=152_064, hidden_size=3584, intermediate_size=18_944,
+        num_layers=28, num_heads=28, num_kv_heads=4, head_dim=128,
+        rope_theta=1_000_000.0, max_seq_len=32_768, attn_bias=True,
+    ),
+    # Embedding encoder (nomic-embed-text class: 768-d encoder).
+    "nomic-embed-text": ModelConfig(
+        name="nomic-embed-text", vocab_size=30_528, hidden_size=768,
+        intermediate_size=3072, num_layers=12, num_heads=12, num_kv_heads=12,
+        head_dim=64, rope_theta=1000.0, max_seq_len=8192, tie_embeddings=True,
+        is_encoder=True,
+    ),
+    "test-tiny-embed": ModelConfig(
+        name="test-tiny-embed", vocab_size=512, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=4,
+        head_dim=16, rope_theta=1000.0, max_seq_len=512, tie_embeddings=True,
+        is_encoder=True,
+    ),
+}
+
+
+def get_model_config(name: str) -> Optional[ModelConfig]:
+    """Smart model lookup: exact → lowercase → tag-stripped.
+
+    Mirrors the reference's `smart_model_match`
+    (/root/reference/src/dispatcher.rs:231-252): `llama3` matches
+    `llama3:8b`/`llama3:latest` and matching is case-insensitive.
+    """
+    if name in MODEL_CONFIGS:
+        return MODEL_CONFIGS[name]
+    low = name.lower()
+    if low in MODEL_CONFIGS:
+        return MODEL_CONFIGS[low]
+    base = low.split(":", 1)[0]
+    for key, cfg in MODEL_CONFIGS.items():
+        if key.split(":", 1)[0] == base:
+            return cfg
+    return None
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Continuous-batching engine configuration."""
+
+    model: str = "test-tiny"
+    # Decode slots = max sequences generating concurrently in one batch.
+    max_slots: int = 64
+    # Paged KV cache: total pages in the pool and tokens per page.
+    num_pages: int = 512
+    page_size: int = 16
+    # Max pages a single sequence may hold (=> max context length).
+    max_pages_per_seq: int = 32
+    # Prefill length buckets (padded; each bucket compiles once).
+    prefill_buckets: tuple = (32, 64, 128, 256, 512, 1024, 2048)
+    # Max new tokens default when request doesn't specify.
+    max_new_tokens: int = 256
+    # Decode steps executed per host-loop iteration when no prefill pending
+    # (amortizes dispatch overhead via lax.scan).
+    decode_steps_per_iter: int = 8
+    # Mesh: (data, tensor) axis sizes; -1 means "all remaining devices".
+    dp: int = 1
+    tp: int = -1
+    dtype: str = "bfloat16"
+    seed: int = 0
+
+    @property
+    def max_context(self) -> int:
+        return self.max_pages_per_seq * self.page_size

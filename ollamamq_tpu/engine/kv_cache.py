@@ -1,0 +1,119 @@
+"""Paged KV cache: device slot pool + host-side page allocator.
+
+Device side: two arrays per model, [num_layers, num_pages*page_size,
+kv_heads, head_dim] for K and V, kv-heads sharded over the "tensor" mesh
+axis. The pool is allocated ONCE at engine start (static shape => no
+recompiles, no fragmentation in HBM).
+
+Host side: a free-list allocator of page indices. Page 0 is RESERVED as the
+trash page: page-table rows are padded with it so static-shaped prefill
+scatter writes of padding tokens land harmlessly (see
+models/llama.py:forward_prefill).
+
+Cancellation reclaims pages immediately — the TPU analogue of the
+reference dropping a disconnected client's stream
+(/root/reference/src/dispatcher.rs:537-551) plus freeing the backend slot.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ollamamq_tpu.config import EngineConfig, ModelConfig
+
+TRASH_PAGE = 0
+
+
+class PageAllocator:
+    """Free-list allocator over page indices [1, num_pages)."""
+
+    def __init__(self, num_pages: int, page_size: int, max_pages_per_seq: int):
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.max_pages_per_seq = max_pages_per_seq
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))  # page 0 reserved
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    def pages_needed(self, num_tokens: int) -> int:
+        return max(1, -(-num_tokens // self.page_size))
+
+    def can_alloc(self, num_tokens: int) -> bool:
+        return self.pages_needed(num_tokens) <= len(self._free)
+
+    def alloc(self, num_tokens: int) -> Optional[List[int]]:
+        """Allocate pages to hold num_tokens; None if pool exhausted."""
+        n = self.pages_needed(num_tokens)
+        if n > len(self._free):
+            return None
+        return [self._free.pop() for _ in range(n)]
+
+    def extend(self, pages: List[int], new_total_tokens: int) -> bool:
+        """Grow an allocation to cover new_total_tokens. False if exhausted
+        or per-seq page cap reached."""
+        need = self.pages_needed(new_total_tokens)
+        while len(pages) < need:
+            if not self._free or len(pages) >= self.max_pages_per_seq:
+                return False
+            pages.append(self._free.pop())
+        return True
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            if p != TRASH_PAGE:
+                self._free.append(p)
+        pages.clear()
+
+
+def make_page_table_row(pages: List[int], max_pages: int) -> np.ndarray:
+    """Pad a page list with the trash page to the static table width."""
+    row = np.full((max_pages,), TRASH_PAGE, dtype=np.int32)
+    row[: len(pages)] = pages
+    return row
+
+
+def alloc_kv_pool(
+    model_cfg: ModelConfig,
+    engine_cfg: EngineConfig,
+    sharding=None,
+    dtype=jnp.bfloat16,
+):
+    """Allocate the device K/V slot pools (zeros). Returns (k_cache, v_cache)."""
+    shape = (
+        model_cfg.num_layers,
+        engine_cfg.num_pages * engine_cfg.page_size,
+        model_cfg.num_kv_heads,
+        model_cfg.head_dim,
+    )
+    if sharding is not None:
+        zeros = jax.jit(
+            lambda: jnp.zeros(shape, dtype), out_shardings=(sharding)
+        )
+        k = zeros()
+        v = zeros()
+    else:
+        k = jnp.zeros(shape, dtype)
+        v = jnp.zeros(shape, dtype)
+    return k, v
+
+
+def kv_pool_bytes(model_cfg: ModelConfig, engine_cfg: EngineConfig, bytes_per_el=2) -> int:
+    return (
+        2
+        * model_cfg.num_layers
+        * engine_cfg.num_pages
+        * engine_cfg.page_size
+        * model_cfg.num_kv_heads
+        * model_cfg.head_dim
+        * bytes_per_el
+    )

@@ -1,0 +1,215 @@
+"""Llama/Qwen-family decoder in pure functional JAX.
+
+Design notes (TPU-first):
+  - Layer parameters are STACKED along a leading `num_layers` axis and the
+    forward is a `lax.scan` over layers — one traced layer body, fast XLA
+    compile, and the KV cache ([L, S, Hk, hd]) scans naturally alongside.
+  - Two entry points: `forward_prefill` (padded bucket, causal attention,
+    writes the prompt's K/V into paged slots) and `forward_decode` (one
+    token per slot, paged attention over the slot pool). Both are shape-
+    static => jit once per (bucket, batch) and never recompile.
+  - All matmuls run in the params dtype (bf16 on TPU => MXU), softmax and
+    logits in f32.
+  - Qwen2.5 support = `attn_bias=True` in ModelConfig; the same code path
+    serves both families (capability parity with the reference's two
+    stress-test models, /root/reference/test_dispatcher.sh:5-7).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ollamamq_tpu.config import ModelConfig
+from ollamamq_tpu.ops.attention import (
+    causal_attention,
+    bidirectional_attention,
+    flat_slot_indices,
+    paged_decode_attention,
+)
+from ollamamq_tpu.ops.rope import apply_rope
+
+
+def rmsnorm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
+    """Random-init a params pytree (layers stacked on axis 0)."""
+    d, qd, kvd, f = cfg.hidden_size, cfg.q_dim, cfg.kv_dim, cfg.intermediate_size
+    L, v = cfg.num_layers, cfg.vocab_size
+    keys = jax.random.split(key, 10)
+
+    def w(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(dtype)
+
+    layers = {
+        "attn_norm": jnp.ones((L, d), dtype),
+        "wq": w(keys[0], (L, d, qd), d),
+        "wk": w(keys[1], (L, d, kvd), d),
+        "wv": w(keys[2], (L, d, kvd), d),
+        "wo": w(keys[3], (L, qd, d), qd),
+        "mlp_norm": jnp.ones((L, d), dtype),
+        "w_gate": w(keys[4], (L, d, f), d),
+        "w_up": w(keys[5], (L, d, f), d),
+        "w_down": w(keys[6], (L, f, d), f),
+    }
+    if cfg.attn_bias:
+        layers["bq"] = jnp.zeros((L, qd), dtype)
+        layers["bk"] = jnp.zeros((L, kvd), dtype)
+        layers["bv"] = jnp.zeros((L, kvd), dtype)
+    params = {
+        "embed": w(keys[7], (v, d), d),
+        "final_norm": jnp.ones((d,), dtype),
+        "layers": layers,
+    }
+    if not cfg.tie_embeddings and not cfg.is_encoder:
+        params["lm_head"] = w(keys[8], (v, d), d)
+    return params
+
+
+def _qkv(cfg: ModelConfig, lp: dict, h: jnp.ndarray):
+    """Project hidden -> q,k,v with head reshape. h: [B, T, D]."""
+    B, T, _ = h.shape
+    q = jnp.einsum("btd,de->bte", h, lp["wq"])
+    k = jnp.einsum("btd,de->bte", h, lp["wk"])
+    v = jnp.einsum("btd,de->bte", h, lp["wv"])
+    if cfg.attn_bias:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
+    k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    return q, k, v
+
+
+def _mlp(lp: dict, h: jnp.ndarray) -> jnp.ndarray:
+    gate = jnp.einsum("btd,df->btf", h, lp["w_gate"])
+    up = jnp.einsum("btd,df->btf", h, lp["w_up"])
+    return jnp.einsum("btf,fd->btd", jax.nn.silu(gate) * up, lp["w_down"])
+
+
+def _logits(params: dict, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head", params["embed"])
+    return jnp.einsum("btd,vd->btv", x.astype(jnp.float32), head.astype(jnp.float32))
+
+
+def forward_prefill(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, T] int32, right-padded
+    seq_lens: jnp.ndarray,  # [B] valid lengths
+    k_cache: jnp.ndarray,  # [L, S, Hk, hd] flat slot pool (donated)
+    v_cache: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, max_pages]; padding rows point at trash page
+    page_size: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Process fresh prompts; returns (last_logits [B, V], k_cache', v_cache').
+
+    Padding positions scatter into the allocator's reserved trash page, so
+    the write is fully static-shaped — no dynamic trimming needed.
+    """
+    B, T = tokens.shape
+    x = params["embed"][tokens].astype(params["embed"].dtype)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    slots = flat_slot_indices(page_table, positions, page_size)  # [B, T]
+
+    def body(carry, per_layer):
+        x = carry
+        lp, kc, vc = per_layer
+        h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(cfg, lp, h)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        kc = kc.at[slots].set(k)
+        vc = vc.at[slots].set(v)
+        attn = causal_attention(q, k, v, seq_lens)
+        x = x + jnp.einsum("bte,ed->btd", attn.reshape(B, T, cfg.q_dim), lp["wo"])
+        h2 = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(lp, h2)
+        return x, (kc, vc)
+
+    x, (k_cache, v_cache) = jax.lax.scan(
+        body, x, (params["layers"], k_cache, v_cache)
+    )
+    last = jnp.clip(seq_lens - 1, 0, T - 1)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)  # [B,1,D]
+    logits = _logits(params, cfg, x_last)[:, 0, :]  # [B, V]
+    return logits, k_cache, v_cache
+
+
+def forward_decode(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B] int32 — last generated token per slot
+    positions: jnp.ndarray,  # [B] int32 — position of `tokens` in each seq
+    k_cache: jnp.ndarray,  # [L, S, Hk, hd] (donated)
+    v_cache: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, max_pages]
+    page_size: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One decode step for the whole batch; returns (logits [B,V], caches')."""
+    B = tokens.shape[0]
+    x = params["embed"][tokens].astype(params["embed"].dtype)[:, None, :]  # [B,1,D]
+    pos2 = positions[:, None]  # [B,1]
+    write_slots = flat_slot_indices(page_table, pos2, page_size)[:, 0]  # [B]
+    seq_lens = positions + 1
+
+    def body(carry, per_layer):
+        x = carry
+        lp, kc, vc = per_layer
+        h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(cfg, lp, h)  # [B,1,H,hd]
+        q = apply_rope(q, pos2, cfg.rope_theta)
+        k = apply_rope(k, pos2, cfg.rope_theta)
+        kc = kc.at[write_slots].set(k[:, 0])
+        vc = vc.at[write_slots].set(v[:, 0])
+        attn = paged_decode_attention(
+            q[:, 0], kc, vc, page_table, seq_lens, page_size
+        )  # [B,H,hd]
+        x = x + jnp.einsum("be,ed->bd", attn.reshape(B, cfg.q_dim), lp["wo"])[:, None, :]
+        h2 = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(lp, h2)
+        return x, (kc, vc)
+
+    x, (k_cache, v_cache) = jax.lax.scan(
+        body, x, (params["layers"], k_cache, v_cache)
+    )
+    logits = _logits(params, cfg, x)[:, 0, :]
+    return logits, k_cache, v_cache
+
+
+def forward_encoder(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, T]
+    seq_lens: jnp.ndarray,  # [B]
+) -> jnp.ndarray:
+    """Embedding encoder: bidirectional attention + masked mean pool + L2 norm."""
+    B, T = tokens.shape
+    x = params["embed"][tokens].astype(params["embed"].dtype)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+
+    def body(carry, lp):
+        x = carry
+        h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(cfg, lp, h)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        attn = bidirectional_attention(q, k, v, seq_lens)
+        x = x + jnp.einsum("bte,ed->btd", attn.reshape(B, T, cfg.q_dim), lp["wo"])
+        h2 = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(lp, h2)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps).astype(jnp.float32)
+    mask = (positions < seq_lens[:, None]).astype(jnp.float32)[:, :, None]
+    pooled = jnp.sum(x * mask, axis=1) / jnp.maximum(jnp.sum(mask, axis=1), 1.0)
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)

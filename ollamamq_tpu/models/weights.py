@@ -1,0 +1,123 @@
+"""Weight initialization and checkpoint loading.
+
+Checkpoints load from either:
+  - a safetensors directory in the HF layout (Llama/Qwen2 tensor names), or
+  - an orbax checkpoint previously saved by `save_orbax`.
+
+Weights land directly in their mesh sharding (each host/device only
+materializes its shard) — the TPU analogue of the reference's
+"models live inside Ollama" (it never touches weights at all).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ollamamq_tpu.config import ModelConfig
+from ollamamq_tpu.models import llama
+
+
+# HF tensor name -> (our tree path, transpose?) for one layer.
+_HF_LAYER_MAP = {
+    "input_layernorm.weight": ("attn_norm", False),
+    "self_attn.q_proj.weight": ("wq", True),
+    "self_attn.k_proj.weight": ("wk", True),
+    "self_attn.v_proj.weight": ("wv", True),
+    "self_attn.o_proj.weight": ("wo", True),
+    "self_attn.q_proj.bias": ("bq", False),
+    "self_attn.k_proj.bias": ("bk", False),
+    "self_attn.v_proj.bias": ("bv", False),
+    "post_attention_layernorm.weight": ("mlp_norm", False),
+    "mlp.gate_proj.weight": ("w_gate", True),
+    "mlp.up_proj.weight": ("w_up", True),
+    "mlp.down_proj.weight": ("w_down", True),
+}
+
+
+def init_random(cfg: ModelConfig, seed: int = 0, dtype=jnp.bfloat16) -> dict:
+    return llama.init_params(cfg, jax.random.PRNGKey(seed), dtype=dtype)
+
+
+def load_safetensors(cfg: ModelConfig, path: str, dtype=jnp.bfloat16) -> dict:
+    """Load an HF-layout safetensors checkpoint into the stacked-layer tree."""
+    from safetensors import safe_open
+
+    files = sorted(
+        os.path.join(path, f) for f in os.listdir(path) if f.endswith(".safetensors")
+    )
+    if not files:
+        raise FileNotFoundError(f"no .safetensors files under {path}")
+
+    raw = {}
+    for f in files:
+        with safe_open(f, framework="np") as sf:
+            for name in sf.keys():
+                raw[name] = sf.get_tensor(name)
+
+    def grab(name: str, transpose: bool) -> np.ndarray:
+        t = raw[name]
+        if t.dtype == np.uint16:  # bfloat16 stored raw
+            t = t.view(np.uint16).astype(np.uint32) << 16
+            t = t.view(np.float32)
+        t = np.asarray(t, dtype=np.float32)
+        return t.T if transpose else t
+
+    layer_names = [k for k in raw if re.match(r"model\.layers\.\d+\.", k)]
+    n_layers = 1 + max(int(k.split(".")[2]) for k in layer_names)
+    if n_layers != cfg.num_layers:
+        raise ValueError(f"checkpoint has {n_layers} layers, config {cfg.num_layers}")
+
+    layers: dict = {}
+    for hf_suffix, (ours, tr) in _HF_LAYER_MAP.items():
+        key0 = f"model.layers.0.{hf_suffix}"
+        if key0 not in raw:
+            continue
+        stack = np.stack(
+            [grab(f"model.layers.{i}.{hf_suffix}", tr) for i in range(cfg.num_layers)]
+        )
+        layers[ours] = jnp.asarray(stack, dtype=dtype)
+
+    params = {
+        "embed": jnp.asarray(grab("model.embed_tokens.weight", False), dtype=dtype),
+        "final_norm": jnp.asarray(grab("model.norm.weight", False), dtype=dtype),
+        "layers": layers,
+    }
+    if "lm_head.weight" in raw and not cfg.tie_embeddings:
+        params["lm_head"] = jnp.asarray(grab("lm_head.weight", False), dtype=dtype)
+    return params
+
+
+def save_orbax(params: dict, path: str) -> None:
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(path), params)
+    ckptr.wait_until_finished()
+
+
+def load_orbax(path: str) -> dict:
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(os.path.abspath(path))
+
+
+def load_params(
+    cfg: ModelConfig,
+    checkpoint_path: Optional[str] = None,
+    seed: int = 0,
+    dtype=jnp.bfloat16,
+) -> dict:
+    """Resolve weights: checkpoint dir (safetensors/orbax) or random init."""
+    if checkpoint_path:
+        entries = os.listdir(checkpoint_path)
+        if any(e.endswith(".safetensors") for e in entries):
+            return load_safetensors(cfg, checkpoint_path, dtype=dtype)
+        return load_orbax(checkpoint_path)
+    return init_random(cfg, seed=seed, dtype=dtype)

@@ -1,0 +1,116 @@
+"""Attention ops: causal prefill attention and paged decode attention.
+
+The paged decode path is the TPU replacement for the reference's
+one-request-per-backend model (/root/reference/src/dispatcher.rs:438):
+many sequences share one forward step, each reading its own scattered KV
+pages. The jnp implementations here are the semantic reference; the Pallas
+ragged-paged-attention kernel (ollamamq_tpu/ops/pallas) is the fast path
+and must match these numerically.
+
+KV cache layout (flat token-slot pool, page-aligned):
+    k_cache, v_cache: [num_layers, num_pages * page_size, kv_heads, head_dim]
+A "page" is page_size contiguous slots; the host-side allocator
+(engine/kv_cache.py) hands out page indices, and `flat_slot_indices`
+translates (page_table, position) -> slot index.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """[.., L, kv_heads, hd] -> [.., L, kv_heads*n_rep, hd] (GQA head groups)."""
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=-2)
+
+
+def causal_attention(
+    q: jnp.ndarray,  # [B, T, H, hd]
+    k: jnp.ndarray,  # [B, T, Hk, hd]
+    v: jnp.ndarray,  # [B, T, Hk, hd]
+    seq_lens: jnp.ndarray,  # [B] valid lengths (padding masked out)
+) -> jnp.ndarray:
+    """Causal self-attention over a padded prefill batch. f32 softmax."""
+    B, T, H, hd = q.shape
+    n_rep = H // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    logits = logits * scale
+    pos = jnp.arange(T)
+    causal = pos[None, :] <= pos[:, None]  # [q, k]
+    valid = pos[None, None, :] < seq_lens[:, None, None]  # [B, 1, k]
+    mask = causal[None, None, :, :] & valid[:, None, :, :]
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def bidirectional_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, seq_lens: jnp.ndarray
+) -> jnp.ndarray:
+    """Full (non-causal) attention for encoder/embedding models."""
+    B, T, H, hd = q.shape
+    n_rep = H // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+    logits = logits * scale
+    pos = jnp.arange(T)
+    valid = pos[None, None, None, :] < seq_lens[:, None, None, None]
+    logits = jnp.where(valid, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def flat_slot_indices(
+    page_table: jnp.ndarray,  # [B, max_pages] int32 page ids
+    positions: jnp.ndarray,  # [B, L] int32 token positions within each seq
+    page_size: int,
+) -> jnp.ndarray:
+    """Translate per-sequence token positions to flat cache slot indices."""
+    page = jnp.take_along_axis(page_table, positions // page_size, axis=-1)
+    return page * page_size + positions % page_size
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,  # [B, H, hd] one new token per sequence
+    k_cache: jnp.ndarray,  # [S, Hk, hd] flat slot pool for ONE layer
+    v_cache: jnp.ndarray,  # [S, Hk, hd]
+    page_table: jnp.ndarray,  # [B, max_pages]
+    seq_lens: jnp.ndarray,  # [B] context length INCLUDING the new token
+    page_size: int,
+) -> jnp.ndarray:
+    """Decode attention: each query attends to its own paged context.
+
+    jnp reference path: gathers the full (padded) context per sequence.
+    The Pallas kernel replaces this with per-page reads and no
+    materialization.
+    """
+    B, H, hd = q.shape
+    max_pages = page_table.shape[1]
+    L = max_pages * page_size
+    positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (B, L))
+    slots = flat_slot_indices(page_table, positions, page_size)  # [B, L]
+    k = k_cache[slots]  # [B, L, Hk, hd]
+    v = v_cache[slots]
+    n_rep = H // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+    logits = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32), k.astype(jnp.float32))
+    logits = logits * scale
+    valid = positions < seq_lens[:, None]
+    logits = jnp.where(valid[:, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhl,blhd->bhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -1,0 +1,89 @@
+"""Token sampling under jit: greedy, temperature, top-k, top-p.
+
+All branches are trace-friendly (no data-dependent Python control flow):
+the sampling mode is encoded in per-sequence parameter vectors so one
+compiled decode step serves heterogeneous per-request options — requests
+with different temperatures share a batch, unlike the reference which
+forwards options opaquely to Ollama.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    """Host-side per-request sampling options (Ollama/OpenAI option names)."""
+
+    temperature: float = 0.0  # 0 => greedy
+    top_k: int = 0  # 0 => disabled
+    top_p: float = 1.0
+    seed: int = 0
+    max_tokens: int = 256
+    stop: tuple = ()
+
+    @classmethod
+    def from_ollama_options(cls, options: dict, max_tokens_default: int) -> "SamplingParams":
+        options = options or {}
+        return cls(
+            temperature=float(options.get("temperature", 0.8) or 0.0),
+            top_k=int(options.get("top_k", 0) or 0),
+            top_p=float(options.get("top_p", 1.0) or 1.0),
+            seed=int(options.get("seed", 0) or 0),
+            max_tokens=int(options.get("num_predict", max_tokens_default) or max_tokens_default),
+            stop=tuple(options.get("stop", []) or []),
+        )
+
+    @classmethod
+    def from_openai(cls, body: dict, max_tokens_default: int) -> "SamplingParams":
+        stop = body.get("stop") or []
+        if isinstance(stop, str):
+            stop = [stop]
+        return cls(
+            temperature=float(body.get("temperature", 1.0) or 0.0),
+            top_k=0,
+            top_p=float(body.get("top_p", 1.0) or 1.0),
+            seed=int(body.get("seed", 0) or 0),
+            max_tokens=int(
+                body.get("max_tokens") or body.get("max_completion_tokens") or max_tokens_default
+            ),
+            stop=tuple(stop),
+        )
+
+
+def sample_tokens(
+    logits: jnp.ndarray,  # [B, V] float32
+    key: jax.Array,
+    temperature: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B] int32 (0 = off)
+    top_p: jnp.ndarray,  # [B]
+) -> jnp.ndarray:
+    """Vectorized per-sequence sampling. Greedy where temperature == 0."""
+    B, V = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / safe_t[:, None]
+
+    # top-k mask: keep the k largest (k==0 -> keep all).
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B, V] descending
+    k_idx = jnp.clip(top_k - 1, 0, V - 1)
+    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)  # [B,1]
+    topk_mask = jnp.where((top_k > 0)[:, None], scaled >= kth, True)
+
+    # top-p (nucleus) mask over the sorted distribution.
+    probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    # keep tokens whose prob >= the threshold prob at the nucleus boundary
+    cutoff_count = jnp.sum(cum - probs_sorted < top_p[:, None], axis=-1)  # >=1
+    cut_idx = jnp.clip(cutoff_count - 1, 0, V - 1)
+    p_kth = jnp.take_along_axis(sorted_desc, cut_idx[:, None], axis=-1)
+    topp_mask = jnp.where((top_p < 1.0)[:, None], scaled >= p_kth, True)
+
+    masked = jnp.where(topk_mask & topp_mask, scaled, -jnp.inf)
+    sampled = jax.random.categorical(key, masked, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)

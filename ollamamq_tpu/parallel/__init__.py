@@ -1,0 +1,6 @@
+from ollamamq_tpu.parallel.mesh import make_mesh, AXIS_DATA, AXIS_TENSOR, AXIS_SEQ
+from ollamamq_tpu.parallel.sharding import (
+    param_partition_specs,
+    kv_cache_spec,
+    shard_params,
+)

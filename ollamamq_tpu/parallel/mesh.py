@@ -1,0 +1,70 @@
+"""Device mesh construction.
+
+The reference's only parallelism is request-level load balancing across
+HTTP backends (/root/reference/src/dispatcher.rs:434-482). Here parallelism
+is a jax.sharding.Mesh over TPU chips with named axes:
+
+  - "data":   replica/data parallelism (independent batches / model replicas)
+  - "tensor": tensor parallelism within a replica — attention heads and MLP
+              hidden dim sharded; XLA emits allgather/reduce-scatter over ICI
+  - "seq":    sequence/context parallelism for long-context ring attention
+
+Multi-host: `jax.distributed.initialize` is handled in
+ollamamq_tpu.parallel.distributed; this module only arranges whatever
+`jax.devices()` reports into a mesh.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_TENSOR = "tensor"
+AXIS_SEQ = "seq"
+
+
+def make_mesh(
+    dp: int = 1,
+    tp: int = -1,
+    sp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (data, seq, tensor) mesh.
+
+    `tp=-1` means "all devices not consumed by dp*sp". The tensor axis is
+    innermost so TP collectives ride the fastest ICI links (adjacent chips).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if tp == -1:
+        if n % (dp * sp) != 0:
+            raise ValueError(f"{n} devices not divisible by dp*sp={dp * sp}")
+        tp = n // (dp * sp)
+    if dp * sp * tp != n:
+        raise ValueError(f"dp*sp*tp={dp * sp * tp} != {n} devices")
+    arr = np.asarray(devices).reshape(dp, sp, tp)
+    return Mesh(arr, (AXIS_DATA, AXIS_SEQ, AXIS_TENSOR))
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh(dp=1, sp=1, tp=1, devices=jax.devices()[:1])
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]
+
+
+def validate_tp_for_model(tp: int, num_kv_heads: int, num_heads: int) -> None:
+    """TP must divide the head counts so shards stay aligned (MXU tiling)."""
+    if num_heads % tp != 0:
+        raise ValueError(f"num_heads={num_heads} not divisible by tp={tp}")
+    if num_kv_heads % tp != 0 and tp % num_kv_heads != 0:
+        raise ValueError(
+            f"num_kv_heads={num_kv_heads} incompatible with tp={tp}: "
+            "needs kv_heads % tp == 0 (sharded) or tp % kv_heads == 0 (replicated groups)"
+        )

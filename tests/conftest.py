@@ -1,0 +1,34 @@
+"""Test config: force JAX onto CPU with 8 virtual devices BEFORE jax import,
+so mesh/sharding logic is exercised without a TPU (SURVEY.md §4)."""
+
+import os
+
+# Force CPU even if the shell exports a TPU platform (e.g. JAX_PLATFORMS=axon).
+# A sitecustomize may already have imported jax and registered a TPU plugin,
+# so setting the env var alone is not enough — use jax.config as well.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tiny_cfg():
+    from ollamamq_tpu.config import MODEL_CONFIGS
+
+    return MODEL_CONFIGS["test-tiny"]
+
+
+@pytest.fixture(scope="session")
+def tiny_params(tiny_cfg):
+    import jax
+    import jax.numpy as jnp
+    from ollamamq_tpu.models import llama
+
+    return llama.init_params(tiny_cfg, jax.random.PRNGKey(0), dtype=jnp.float32)

@@ -1,0 +1,66 @@
+"""Mesh/sharding: TP-sharded forward must match unsharded numerics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as PS
+
+from ollamamq_tpu.engine import kv_cache as kvc
+from ollamamq_tpu.models import llama
+from ollamamq_tpu.parallel import (
+    make_mesh,
+    param_partition_specs,
+    kv_cache_spec,
+    shard_params,
+)
+
+PAGE_SIZE = 8
+MAX_PAGES = 8
+
+
+def test_mesh_shapes():
+    mesh = make_mesh(dp=2, tp=-1)
+    assert mesh.shape["data"] == 2 and mesh.shape["tensor"] == 4
+    mesh = make_mesh(dp=1, sp=2, tp=4)
+    assert mesh.shape["seq"] == 2
+
+
+def test_partition_specs(tiny_cfg, tiny_params):
+    specs = param_partition_specs(tiny_params)
+    assert specs["layers"]["wq"] == PS(None, None, "tensor")
+    assert specs["layers"]["wo"] == PS(None, "tensor", None)
+    assert specs["embed"] == PS("tensor", None)
+    assert specs["final_norm"] == PS()
+
+
+def test_tp_forward_matches_single_device(tiny_cfg, tiny_params):
+    cfg, params = tiny_cfg, tiny_params
+    tokens = jnp.array([[1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+    seq_lens = jnp.array([8])
+
+    def run(params, kc, vc, pt):
+        return llama.forward_prefill(params, cfg, tokens, seq_lens, kc, vc, pt, PAGE_SIZE)
+
+    # Unsharded reference.
+    shape = (cfg.num_layers, 32 * PAGE_SIZE, cfg.num_kv_heads, cfg.head_dim)
+    kc = jnp.zeros(shape, jnp.float32)
+    vc = jnp.zeros(shape, jnp.float32)
+    a = kvc.PageAllocator(32, PAGE_SIZE, MAX_PAGES)
+    pt = jnp.asarray(np.stack([kvc.make_page_table_row(a.alloc(8), MAX_PAGES)]))
+    ref_logits, ref_kc, _ = run(params, kc, vc, pt)
+
+    # TP=2 sharded on the virtual CPU mesh.
+    mesh = make_mesh(dp=1, tp=2, devices=jax.devices()[:2])
+    sp = shard_params(params, mesh)
+    kv_shard = NamedSharding(mesh, kv_cache_spec())
+    kc2 = jax.device_put(jnp.zeros(shape, jnp.float32), kv_shard)
+    vc2 = jax.device_put(jnp.zeros(shape, jnp.float32), kv_shard)
+    with jax.set_mesh(mesh):
+        tp_logits, tp_kc, _ = jax.jit(run)(sp, kc2, vc2, pt)
+
+    np.testing.assert_allclose(
+        np.asarray(ref_logits), np.asarray(tp_logits), rtol=1e-4, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(ref_kc), np.asarray(tp_kc), rtol=1e-4, atol=1e-4
+    )
