@@ -1,0 +1,437 @@
+/* mqcore implementation. See mqcore.h for the policy contract. */
+
+#include "mqcore.h"
+
+#include <algorithm>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <fstream>
+#include <map>
+#include <mutex>
+#include <set>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Task {
+  int64_t req_id;
+  std::string user;
+  std::string model;  // empty = none requested
+  int api_family;
+};
+
+std::string lower(const std::string &s) {
+  std::string r = s;
+  std::transform(r.begin(), r.end(), r.begin(),
+                 [](unsigned char c) { return std::tolower(c); });
+  return r;
+}
+
+std::string strip_tag(const std::string &s) {
+  auto pos = s.find(':');
+  return pos == std::string::npos ? s : s.substr(0, pos);
+}
+
+/* smart model match (dispatcher.rs:231-252): exact -> lowercase ->
+ * tag-stripped, each tried against the available set both ways. */
+bool smart_model_match(const std::string &want,
+                       const std::vector<std::string> &have) {
+  for (const auto &h : have)
+    if (h == want) return true;
+  std::string wl = lower(want);
+  for (const auto &h : have)
+    if (lower(h) == wl) return true;
+  std::string wb = strip_tag(wl);
+  for (const auto &h : have)
+    if (strip_tag(lower(h)) == wb) return true;
+  return false;
+}
+
+void json_escape(std::string &out, const std::string &s) {
+  out += '"';
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += (char)c;
+        }
+    }
+  }
+  out += '"';
+}
+
+/* Tiny JSON string-array scanner sufficient for the blocklist schema
+ * {"blocked_ips": [...], "blocked_users": [...]} (dispatcher.rs:19-25).
+ * Not a general parser; unknown content is ignored. */
+std::vector<std::string> scan_string_array(const std::string &text,
+                                           const std::string &key) {
+  std::vector<std::string> out;
+  auto kpos = text.find("\"" + key + "\"");
+  if (kpos == std::string::npos) return out;
+  auto open = text.find('[', kpos);
+  if (open == std::string::npos) return out;
+  size_t i = open + 1;
+  while (i < text.size() && text[i] != ']') {
+    if (text[i] == '"') {
+      std::string s;
+      ++i;
+      while (i < text.size() && text[i] != '"') {
+        if (text[i] == '\\' && i + 1 < text.size()) {
+          char n = text[i + 1];
+          if (n == 'n') s += '\n';
+          else if (n == 't') s += '\t';
+          else if (n == 'r') s += '\r';
+          else s += n;
+          i += 2;
+        } else {
+          s += text[i++];
+        }
+      }
+      ++i;
+      out.push_back(s);
+    } else {
+      ++i;
+    }
+  }
+  return out;
+}
+
+}  // namespace
+
+struct mq_state {
+  std::mutex mu;
+
+  std::map<std::string, std::deque<Task>> queues;
+  std::map<std::string, int64_t> processing_counts;
+  std::map<std::string, int64_t> processed_counts;
+  std::map<std::string, int64_t> dropped_counts;
+  std::map<std::string, int64_t> served_tokens;
+  std::map<std::string, std::string> user_ips;
+  std::set<std::string> blocked_users;
+  std::set<std::string> blocked_ips;
+  std::string vip_user;    // empty = none
+  std::string boost_user;  // empty = none
+  int64_t global_counter = 0;
+  size_t rr_cursor = 0;  // persistent across rounds (dispatcher.rs run_worker local)
+  int64_t next_req_id = 1;
+  int fairness_mode = MQ_FAIR_REQUESTS;
+  std::string blocklist_path;
+
+  void save_blocklist_locked() {
+    if (blocklist_path.empty()) return;
+    std::string out = "{\n  \"blocked_ips\": [";
+    bool first = true;
+    for (const auto &ip : blocked_ips) {
+      if (!first) out += ", ";
+      json_escape(out, ip);
+      first = false;
+    }
+    out += "],\n  \"blocked_users\": [";
+    first = true;
+    for (const auto &u : blocked_users) {
+      if (!first) out += ", ";
+      json_escape(out, u);
+      first = false;
+    }
+    out += "]\n}\n";
+    std::ofstream f(blocklist_path, std::ios::trunc);
+    f << out;
+  }
+
+  void load_blocklist() {
+    if (blocklist_path.empty()) return;
+    std::ifstream f(blocklist_path);
+    if (!f) return;
+    std::stringstream ss;
+    ss << f.rdbuf();
+    std::string text = ss.str();
+    for (auto &ip : scan_string_array(text, "blocked_ips")) blocked_ips.insert(ip);
+    for (auto &u : scan_string_array(text, "blocked_users")) blocked_users.insert(u);
+  }
+
+  int64_t fairness_count_locked(const std::string &user) {
+    auto &m = fairness_mode == MQ_FAIR_TOKENS ? served_tokens : processed_counts;
+    auto it = m.find(user);
+    return it == m.end() ? 0 : it->second;
+  }
+};
+
+extern "C" {
+
+mq_state *mq_new(const char *blocklist_path) {
+  auto *s = new mq_state();
+  if (blocklist_path) s->blocklist_path = blocklist_path;
+  s->load_blocklist();
+  return s;
+}
+
+void mq_destroy(mq_state *s) { delete s; }
+
+int64_t mq_enqueue(mq_state *s, const char *user, const char *ip,
+                   const char *model, int api_family) {
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string u = user ? user : "anonymous";
+  std::string i = ip ? ip : "";
+  if (s->blocked_users.count(u)) return -1;
+  if (!i.empty() && s->blocked_ips.count(i)) return -2;
+  if (!i.empty()) s->user_ips[u] = i;
+  Task t;
+  t.req_id = s->next_req_id++;
+  t.user = u;
+  t.model = model ? model : "";
+  t.api_family = api_family;
+  s->queues[u].push_back(std::move(t));
+  return s->queues[u].back().req_id;
+}
+
+int64_t mq_next(mq_state *s, const char *eligible_models, char *out_user,
+                int user_cap, char *out_model, int model_cap) {
+  std::lock_guard<std::mutex> g(s->mu);
+
+  std::vector<std::string> active;
+  for (auto &kv : s->queues)
+    if (!kv.second.empty()) active.push_back(kv.first);
+  if (active.empty()) return MQ_EMPTY;
+
+  std::stable_sort(active.begin(), active.end(),
+                   [&](const std::string &a, const std::string &b) {
+                     int64_t at = s->fairness_count_locked(a);
+                     int64_t bt = s->fairness_count_locked(b);
+                     if (at != bt) return at < bt;
+                     return a < b;
+                   });
+
+  std::string target;
+  if (!s->vip_user.empty() &&
+      std::find(active.begin(), active.end(), s->vip_user) != active.end()) {
+    target = s->vip_user;
+  }
+  if (target.empty() && !s->boost_user.empty() && s->global_counter % 2 == 0 &&
+      std::find(active.begin(), active.end(), s->boost_user) != active.end()) {
+    target = s->boost_user;
+  }
+  if (target.empty()) {
+    if (s->rr_cursor >= active.size()) s->rr_cursor = 0;
+    target = active[s->rr_cursor];
+    s->rr_cursor += 1;  // advances even if this pick turns out unservable
+  }
+
+  Task &front = s->queues[target].front();
+
+  /* Model/capability gate: the TPU-era analogue of the backend filter
+   * (dispatcher.rs:444-465). NULL => everything eligible. */
+  if (eligible_models != nullptr && !front.model.empty()) {
+    std::vector<std::string> have;
+    std::stringstream ss(eligible_models);
+    std::string line;
+    while (std::getline(ss, line, '\n'))
+      if (!line.empty()) have.push_back(line);
+    if (!smart_model_match(front.model, have)) return MQ_STUCK;
+  }
+
+  Task task = std::move(s->queues[target].front());
+  s->queues[target].pop_front();
+  if (s->queues[target].empty()) s->queues.erase(target);
+  s->global_counter += 1;  // only on successful pop (dispatcher.rs:476)
+
+  std::snprintf(out_user, user_cap, "%s", task.user.c_str());
+  std::snprintf(out_model, model_cap, "%s", task.model.c_str());
+  return task.req_id;
+}
+
+int mq_cancel(mq_state *s, int64_t req_id) {
+  std::lock_guard<std::mutex> g(s->mu);
+  for (auto it = s->queues.begin(); it != s->queues.end(); ++it) {
+    auto &dq = it->second;
+    for (auto t = dq.begin(); t != dq.end(); ++t) {
+      if (t->req_id == req_id) {
+        s->dropped_counts[t->user] += 1;
+        dq.erase(t);
+        if (dq.empty()) s->queues.erase(it);
+        return 1;
+      }
+    }
+  }
+  return 0;
+}
+
+void mq_mark_started(mq_state *s, const char *user) {
+  std::lock_guard<std::mutex> g(s->mu);
+  s->processing_counts[user] += 1;
+}
+
+void mq_mark_done(mq_state *s, const char *user, int64_t tokens_served) {
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->processing_counts.find(user);
+  if (it != s->processing_counts.end() && it->second > 0) it->second -= 1;
+  s->processed_counts[user] += 1;
+  s->served_tokens[user] += tokens_served;
+}
+
+void mq_mark_dropped(mq_state *s, const char *user, int was_started) {
+  std::lock_guard<std::mutex> g(s->mu);
+  if (was_started) {
+    auto it = s->processing_counts.find(user);
+    if (it != s->processing_counts.end() && it->second > 0) it->second -= 1;
+  }
+  s->dropped_counts[user] += 1;
+}
+
+void mq_block_user(mq_state *s, const char *user) {
+  std::lock_guard<std::mutex> g(s->mu);
+  s->blocked_users.insert(user);
+  s->save_blocklist_locked();
+}
+
+void mq_unblock_user(mq_state *s, const char *user) {
+  std::lock_guard<std::mutex> g(s->mu);
+  s->blocked_users.erase(user);
+  s->save_blocklist_locked();
+}
+
+void mq_block_ip(mq_state *s, const char *ip) {
+  std::lock_guard<std::mutex> g(s->mu);
+  s->blocked_ips.insert(ip);
+  s->save_blocklist_locked();
+}
+
+void mq_unblock_ip(mq_state *s, const char *ip) {
+  std::lock_guard<std::mutex> g(s->mu);
+  s->blocked_ips.erase(ip);
+  s->save_blocklist_locked();
+}
+
+int mq_is_user_blocked(mq_state *s, const char *user) {
+  std::lock_guard<std::mutex> g(s->mu);
+  return s->blocked_users.count(user) ? 1 : 0;
+}
+
+int mq_is_ip_blocked(mq_state *s, const char *ip) {
+  std::lock_guard<std::mutex> g(s->mu);
+  return s->blocked_ips.count(ip) ? 1 : 0;
+}
+
+int mq_unblock_item(mq_state *s, const char *item) {
+  std::lock_guard<std::mutex> g(s->mu);
+  int n = (int)s->blocked_users.erase(item) + (int)s->blocked_ips.erase(item);
+  if (n) s->save_blocklist_locked();
+  return n ? 1 : 0;
+}
+
+void mq_set_vip(mq_state *s, const char *user_or_null) {
+  std::lock_guard<std::mutex> g(s->mu);
+  s->vip_user = user_or_null ? user_or_null : "";
+}
+
+void mq_set_boost(mq_state *s, const char *user_or_null) {
+  std::lock_guard<std::mutex> g(s->mu);
+  s->boost_user = user_or_null ? user_or_null : "";
+}
+
+void mq_set_fairness_mode(mq_state *s, int mode) {
+  std::lock_guard<std::mutex> g(s->mu);
+  s->fairness_mode = mode;
+}
+
+int64_t mq_queue_len(mq_state *s, const char *user) {
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->queues.find(user);
+  return it == s->queues.end() ? 0 : (int64_t)it->second.size();
+}
+
+int64_t mq_total_queued(mq_state *s) {
+  std::lock_guard<std::mutex> g(s->mu);
+  int64_t n = 0;
+  for (auto &kv : s->queues) n += (int64_t)kv.second.size();
+  return n;
+}
+
+int64_t mq_snapshot_json(mq_state *s, char *out, int64_t cap) {
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string j = "{";
+
+  std::set<std::string> users;
+  for (auto &kv : s->queues) users.insert(kv.first);
+  for (auto &kv : s->processing_counts) users.insert(kv.first);
+  for (auto &kv : s->processed_counts) users.insert(kv.first);
+  for (auto &kv : s->dropped_counts) users.insert(kv.first);
+
+  j += "\"users\":{";
+  bool first = true;
+  for (const auto &u : users) {
+    if (!first) j += ",";
+    first = false;
+    json_escape(j, u);
+    auto get = [](std::map<std::string, int64_t> &m, const std::string &k) {
+      auto it = m.find(k);
+      return it == m.end() ? (int64_t)0 : it->second;
+    };
+    auto qit = s->queues.find(u);
+    int64_t queued = qit == s->queues.end() ? 0 : (int64_t)qit->second.size();
+    char buf[256];
+    std::snprintf(buf, sizeof buf,
+                  ":{\"queued\":%lld,\"processing\":%lld,\"processed\":%lld,"
+                  "\"dropped\":%lld,\"tokens\":%lld",
+                  (long long)queued,
+                  (long long)get(s->processing_counts, u),
+                  (long long)get(s->processed_counts, u),
+                  (long long)get(s->dropped_counts, u),
+                  (long long)get(s->served_tokens, u));
+    j += buf;
+    auto ipit = s->user_ips.find(u);
+    if (ipit != s->user_ips.end()) {
+      j += ",\"ip\":";
+      json_escape(j, ipit->second);
+    }
+    j += "}";
+  }
+  j += "},";
+
+  j += "\"vip\":";
+  if (s->vip_user.empty()) j += "null"; else json_escape(j, s->vip_user);
+  j += ",\"boost\":";
+  if (s->boost_user.empty()) j += "null"; else json_escape(j, s->boost_user);
+
+  char buf[128];
+  std::snprintf(buf, sizeof buf, ",\"global_counter\":%lld,",
+                (long long)s->global_counter);
+  j += buf;
+
+  j += "\"blocked_users\":[";
+  first = true;
+  for (const auto &u : s->blocked_users) {
+    if (!first) j += ",";
+    json_escape(j, u);
+    first = false;
+  }
+  j += "],\"blocked_ips\":[";
+  first = true;
+  for (const auto &ip : s->blocked_ips) {
+    if (!first) j += ",";
+    json_escape(j, ip);
+    first = false;
+  }
+  j += "]}";
+
+  int64_t need = (int64_t)j.size();
+  if (out && cap > need) {
+    std::memcpy(out, j.data(), j.size());
+    out[j.size()] = '\0';
+    return need;
+  }
+  return need;
+}
+
+}  // extern "C"

@@ -1,0 +1,106 @@
+/* mqcore — native serving core: per-user FIFO queues, fair-share scheduling
+ * with VIP/Boost, user/IP blocklist with JSON persistence, counters.
+ *
+ * This is the C++ re-expression of the reference's dispatcher state machine
+ * (/root/reference/src/dispatcher.rs:112-163 state, :389-494 selection),
+ * re-targeted at a TPU continuous-batching engine: instead of backend URLs,
+ * the caller passes the set of models the engine currently serves, and the
+ * scheduler admits whole requests into the engine's token budget.
+ *
+ * Exact policy parity with the reference:
+ *   - active users sorted by lifetime processed count asc, tie lexicographic
+ *     (dispatcher.rs:408-412)
+ *   - VIP absolute override (dispatcher.rs:415)
+ *   - Boost wins only when global_counter is even (dispatcher.rs:416-419)
+ *   - otherwise a PERSISTENT round-robin cursor that advances on every
+ *     non-VIP/boost selection, even when the pick turns out unservable
+ *     (dispatcher.rs:421-424)
+ *   - global counter increments only on successful pop (dispatcher.rs:476)
+ *   - VIP and Boost are independent slots; both may be held, by different
+ *     users (tui.rs:169-206 clears the other slot only for the SAME user)
+ *   - "stuck in queue": if the policy-selected user's front request can't be
+ *     served, nothing is popped this round (dispatcher.rs:467-473)
+ *
+ * TPU-era extension: served-token accounting per user; fairness can be
+ * switched from request-count to token-count (fairness unit changes when
+ * requests share a batch).
+ *
+ * Thread-safe: one internal mutex; every exported call is atomic.
+ * C ABI for ctypes binding from Python.
+ */
+#ifndef MQCORE_H
+#define MQCORE_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct mq_state mq_state;
+
+/* api_family values (dispatcher.rs:42-55) */
+enum { MQ_FAMILY_UNKNOWN = 0, MQ_FAMILY_OLLAMA = 1, MQ_FAMILY_OPENAI = 2 };
+
+/* fairness modes */
+enum { MQ_FAIR_REQUESTS = 0, MQ_FAIR_TOKENS = 1 };
+
+/* mq_next result codes */
+enum { MQ_EMPTY = 0, MQ_STUCK = -1 };
+
+mq_state *mq_new(const char *blocklist_path);
+void mq_destroy(mq_state *);
+
+/* Enqueue. Returns req_id > 0, or -1 if user blocked, -2 if IP blocked.
+ * Also records user->ip (dispatcher.rs:612-615). */
+int64_t mq_enqueue(mq_state *, const char *user, const char *ip,
+                   const char *model /*nullable*/, int api_family);
+
+/* Pick per policy. eligible_models: '\n'-separated model names the engine
+ * can serve right now (empty string => nothing loaded; NULL => everything
+ * eligible). Returns req_id popped (>0), MQ_EMPTY, or MQ_STUCK. On success
+ * fills out_user/out_model (model may be empty). */
+int64_t mq_next(mq_state *, const char *eligible_models,
+                char *out_user, int user_cap,
+                char *out_model, int model_cap);
+
+/* Remove a still-queued request (client cancel/disconnect before dispatch).
+ * Returns 1 if found+removed (counts dropped), 0 otherwise. */
+int mq_cancel(mq_state *, int64_t req_id);
+
+/* Lifecycle accounting (dispatcher.rs:514-517, 562-573). */
+void mq_mark_started(mq_state *, const char *user);
+void mq_mark_done(mq_state *, const char *user, int64_t tokens_served);
+/* was_started: 1 if mq_mark_started ran for this request (decrements the
+ * processing gauge); 0 if it was dropped before dispatch. */
+void mq_mark_dropped(mq_state *, const char *user, int was_started);
+
+/* Block admin (dispatcher.rs:184-228); persists on every mutation. */
+void mq_block_user(mq_state *, const char *user);
+void mq_unblock_user(mq_state *, const char *user);
+void mq_block_ip(mq_state *, const char *ip);
+void mq_unblock_ip(mq_state *, const char *ip);
+int mq_is_user_blocked(mq_state *, const char *user);
+int mq_is_ip_blocked(mq_state *, const char *ip);
+/* Unblock by either kind (tui 'u' key); returns 1 if anything removed. */
+int mq_unblock_item(mq_state *, const char *item);
+
+/* VIP/boost: set to user or clear with NULL. Toggle semantics (same user
+ * clears the other slot) are the caller's job, mirroring the TUI. */
+void mq_set_vip(mq_state *, const char *user_or_null);
+void mq_set_boost(mq_state *, const char *user_or_null);
+
+void mq_set_fairness_mode(mq_state *, int mode);
+
+/* Queue depth for one user / total queued. */
+int64_t mq_queue_len(mq_state *, const char *user);
+int64_t mq_total_queued(mq_state *);
+
+/* Full state snapshot as JSON (users, counters, queues, vip/boost, blocked).
+ * Returns bytes written (excluding NUL), or required size if cap too small. */
+int64_t mq_snapshot_json(mq_state *, char *out, int64_t cap);
+
+#ifdef __cplusplus
+}
+#endif
+#endif

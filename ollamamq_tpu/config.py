@@ -116,23 +116,33 @@ MODEL_CONFIGS = {
 }
 
 
-def get_model_config(name: str) -> Optional[ModelConfig]:
-    """Smart model lookup: exact → lowercase → tag-stripped.
+def smart_match(name: str, candidates) -> Optional[str]:
+    """Smart model matching: exact → lowercase → tag-stripped.
 
-    Mirrors the reference's `smart_model_match`
+    Single Python implementation of the reference's `smart_model_match`
     (/root/reference/src/dispatcher.rs:231-252): `llama3` matches
-    `llama3:8b`/`llama3:latest` and matching is case-insensitive.
+    `llama3:8b`/`llama3:latest`, matching is case-insensitive. The native
+    scheduler gate (cpp/mqcore.cpp) implements the same algorithm for its
+    in-core eligibility check; tests/test_mqcore.py pins the two together.
     """
-    if name in MODEL_CONFIGS:
-        return MODEL_CONFIGS[name]
+    candidates = list(candidates)
+    if name in candidates:
+        return name
     low = name.lower()
-    if low in MODEL_CONFIGS:
-        return MODEL_CONFIGS[low]
+    by_lower = {c.lower(): c for c in candidates}
+    if low in by_lower:
+        return by_lower[low]
     base = low.split(":", 1)[0]
-    for key, cfg in MODEL_CONFIGS.items():
-        if key.split(":", 1)[0] == base:
-            return cfg
+    for c in candidates:
+        if c.lower().split(":", 1)[0] == base:
+            return c
     return None
+
+
+def get_model_config(name: str) -> Optional[ModelConfig]:
+    """Resolve a requested model name to an architecture via smart_match."""
+    key = smart_match(name, MODEL_CONFIGS.keys())
+    return MODEL_CONFIGS[key] if key is not None else None
 
 
 @dataclasses.dataclass
@@ -154,9 +164,12 @@ class EngineConfig:
     # Decode steps executed per host-loop iteration when no prefill pending
     # (amortizes dispatch overhead via lax.scan).
     decode_steps_per_iter: int = 8
-    # Mesh: (data, tensor) axis sizes; -1 means "all remaining devices".
+    # Mesh axis sizes; tp=-1 means "all remaining devices". The engine
+    # builds its (data, seq, tensor) mesh from these unless an explicit
+    # mesh object is passed to TPUEngine.
     dp: int = 1
-    tp: int = -1
+    sp: int = 1
+    tp: int = 1
     dtype: str = "bfloat16"
     seed: int = 0
 

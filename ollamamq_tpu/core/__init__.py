@@ -1,0 +1,1 @@
+from ollamamq_tpu.core.mqcore import MQCore, Family, Fairness

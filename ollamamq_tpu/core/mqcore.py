@@ -1,0 +1,228 @@
+"""ctypes binding to the native mqcore serving core (cpp/mqcore.cpp).
+
+The shared library is built on demand with `make` the first time it's
+imported (the native toolchain is a hard dependency of the framework, like
+the reference's cargo build). All policy logic lives in C++; this wrapper
+only marshals strings and exposes a pythonic facade.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import enum
+import json
+import os
+import subprocess
+import threading
+from typing import Iterable, Optional, Tuple
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_CPP_DIR = os.path.join(_REPO_ROOT, "cpp")
+_LIB_PATH = os.path.join(_CPP_DIR, "libmqcore.so")
+_BUILD_LOCK = threading.Lock()
+
+
+class Family(enum.IntEnum):
+    UNKNOWN = 0
+    OLLAMA = 1
+    OPENAI = 2
+
+
+class Fairness(enum.IntEnum):
+    REQUESTS = 0
+    TOKENS = 1
+
+
+def _ensure_built() -> str:
+    with _BUILD_LOCK:
+        src = os.path.join(_CPP_DIR, "mqcore.cpp")
+        if not os.path.exists(_LIB_PATH) or (
+            os.path.exists(src) and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+        ):
+            subprocess.run(
+                ["make", "-C", _CPP_DIR], check=True, capture_output=True, text=True
+            )
+    return _LIB_PATH
+
+
+def _load() -> ctypes.CDLL:
+    lib = ctypes.CDLL(_ensure_built())
+    lib.mq_new.restype = ctypes.c_void_p
+    lib.mq_new.argtypes = [ctypes.c_char_p]
+    lib.mq_destroy.argtypes = [ctypes.c_void_p]
+    lib.mq_enqueue.restype = ctypes.c_int64
+    lib.mq_enqueue.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                               ctypes.c_char_p, ctypes.c_int]
+    lib.mq_next.restype = ctypes.c_int64
+    lib.mq_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                            ctypes.c_char_p, ctypes.c_int,
+                            ctypes.c_char_p, ctypes.c_int]
+    lib.mq_cancel.restype = ctypes.c_int
+    lib.mq_cancel.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    for name in ("mq_mark_started", "mq_block_user",
+                 "mq_unblock_user", "mq_block_ip", "mq_unblock_ip",
+                 "mq_set_vip", "mq_set_boost"):
+        fn = getattr(lib, name)
+        fn.restype = None
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.mq_mark_dropped.restype = None
+    lib.mq_mark_dropped.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    lib.mq_mark_done.restype = None
+    lib.mq_mark_done.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.mq_is_user_blocked.restype = ctypes.c_int
+    lib.mq_is_user_blocked.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.mq_is_ip_blocked.restype = ctypes.c_int
+    lib.mq_is_ip_blocked.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.mq_unblock_item.restype = ctypes.c_int
+    lib.mq_unblock_item.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.mq_set_fairness_mode.restype = None
+    lib.mq_set_fairness_mode.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.mq_queue_len.restype = ctypes.c_int64
+    lib.mq_queue_len.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.mq_total_queued.restype = ctypes.c_int64
+    lib.mq_total_queued.argtypes = [ctypes.c_void_p]
+    lib.mq_snapshot_json.restype = ctypes.c_int64
+    lib.mq_snapshot_json.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    return lib
+
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _get_lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        _lib = _load()
+    return _lib
+
+
+EMPTY = 0
+STUCK = -1
+BLOCKED_USER = -1
+BLOCKED_IP = -2
+
+
+class MQCore:
+    """Per-user fair-share queue core (native)."""
+
+    def __init__(self, blocklist_path: Optional[str] = None):
+        self._lib = _get_lib()
+        self._h = ctypes.c_void_p(
+            self._lib.mq_new(blocklist_path.encode() if blocklist_path else None)
+        )
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.mq_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- queue ops ---------------------------------------------------------
+    def enqueue(
+        self,
+        user: str,
+        ip: str = "",
+        model: Optional[str] = None,
+        family: Family = Family.UNKNOWN,
+    ) -> int:
+        """Returns req_id > 0, or raises BlockedError."""
+        rid = self._lib.mq_enqueue(
+            self._h, user.encode(), ip.encode(),
+            model.encode() if model else None, int(family),
+        )
+        if rid == BLOCKED_USER:
+            raise BlockedError("user", user)
+        if rid == BLOCKED_IP:
+            raise BlockedError("ip", ip)
+        return rid
+
+    def next(
+        self, eligible_models: Optional[Iterable[str]] = None
+    ) -> Optional[Tuple[int, str, str]]:
+        """Pop per policy. Returns (req_id, user, model) or None (empty).
+        Raises StuckQueue if the policy pick's model isn't servable."""
+        ubuf = ctypes.create_string_buffer(512)
+        mbuf = ctypes.create_string_buffer(512)
+        em = None
+        if eligible_models is not None:
+            em = "\n".join(eligible_models).encode()
+        rid = self._lib.mq_next(self._h, em, ubuf, len(ubuf), mbuf, len(mbuf))
+        if rid == EMPTY:
+            return None
+        if rid == STUCK:
+            raise StuckQueue()
+        return rid, ubuf.value.decode(), mbuf.value.decode()
+
+    def cancel(self, req_id: int) -> bool:
+        return bool(self._lib.mq_cancel(self._h, req_id))
+
+    # -- accounting --------------------------------------------------------
+    def mark_started(self, user: str) -> None:
+        self._lib.mq_mark_started(self._h, user.encode())
+
+    def mark_done(self, user: str, tokens: int = 0) -> None:
+        self._lib.mq_mark_done(self._h, user.encode(), tokens)
+
+    def mark_dropped(self, user: str, started: bool = True) -> None:
+        self._lib.mq_mark_dropped(self._h, user.encode(), int(started))
+
+    # -- admin -------------------------------------------------------------
+    def block_user(self, user: str) -> None:
+        self._lib.mq_block_user(self._h, user.encode())
+
+    def unblock_user(self, user: str) -> None:
+        self._lib.mq_unblock_user(self._h, user.encode())
+
+    def block_ip(self, ip: str) -> None:
+        self._lib.mq_block_ip(self._h, ip.encode())
+
+    def unblock_ip(self, ip: str) -> None:
+        self._lib.mq_unblock_ip(self._h, ip.encode())
+
+    def unblock_item(self, item: str) -> bool:
+        return bool(self._lib.mq_unblock_item(self._h, item.encode()))
+
+    def is_user_blocked(self, user: str) -> bool:
+        return bool(self._lib.mq_is_user_blocked(self._h, user.encode()))
+
+    def is_ip_blocked(self, ip: str) -> bool:
+        return bool(self._lib.mq_is_ip_blocked(self._h, ip.encode()))
+
+    def set_vip(self, user: Optional[str]) -> None:
+        self._lib.mq_set_vip(self._h, user.encode() if user else None)
+
+    def set_boost(self, user: Optional[str]) -> None:
+        self._lib.mq_set_boost(self._h, user.encode() if user else None)
+
+    def set_fairness(self, mode: Fairness) -> None:
+        self._lib.mq_set_fairness_mode(self._h, int(mode))
+
+    # -- introspection -----------------------------------------------------
+    def queue_len(self, user: str) -> int:
+        return self._lib.mq_queue_len(self._h, user.encode())
+
+    def total_queued(self) -> int:
+        return self._lib.mq_total_queued(self._h)
+
+    def snapshot(self) -> dict:
+        need = self._lib.mq_snapshot_json(self._h, None, 0)
+        buf = ctypes.create_string_buffer(need + 16)
+        self._lib.mq_snapshot_json(self._h, buf, len(buf))
+        return json.loads(buf.value.decode())
+
+
+class BlockedError(Exception):
+    def __init__(self, kind: str, item: str):
+        self.kind = kind
+        self.item = item
+        super().__init__(f"blocked {kind}: {item}")
+
+
+class StuckQueue(Exception):
+    """Policy-selected user's front request can't be served right now
+    (model not loaded / no capacity) — reference's 'stuck in queue'."""

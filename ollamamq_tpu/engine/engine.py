@@ -1,0 +1,721 @@
+"""TPU continuous-batching engine.
+
+This module replaces the reference's entire backend layer: where the Rust
+dispatcher forwarded one request per Ollama backend over HTTP
+(/root/reference/src/dispatcher.rs:496-575) and gated parallelism at
+`active_requests < 1` per backend (dispatcher.rs:438), here many requests
+share one forward step on the TPU:
+
+  - admission: the engine loop pops requests from the native fair-share
+    core (cpp/mqcore.cpp) whenever a model runtime has slot+page capacity —
+    the queue-side policy is identical to the reference, but what's being
+    scheduled is a seat in the decode batch, not a backend slot.
+  - prefill: one padded-bucket forward per new request writes its prompt KV
+    into paged slots and samples the first token (TTFT path).
+  - decode: ONE jitted step advances every active slot by one token; when
+    no admissions are pending the engine runs K steps inside a lax.scan to
+    amortize host dispatch (critical: per-dispatch latency to the chip
+    dominates otherwise).
+  - cancellation: client disconnects free the slot and its KV pages
+    immediately (reference analogue: dispatcher.rs:537-551 drops the stream
+    and frees the backend; here the reclaimed resource is HBM pages).
+
+All step functions are shape-static (fixed slot count, fixed buckets,
+donated caches) => each (bucket, K) compiles exactly once.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ollamamq_tpu.config import EngineConfig, ModelConfig, get_model_config, smart_match
+from ollamamq_tpu.core import MQCore, Fairness
+from ollamamq_tpu.core.mqcore import StuckQueue
+from ollamamq_tpu.engine import kv_cache as kvc
+from ollamamq_tpu.engine.request import FinishReason, Request, StreamItem
+from ollamamq_tpu.engine.tokenizer import ByteTokenizer, load_tokenizer
+from ollamamq_tpu.models import llama, weights
+from ollamamq_tpu.ops.sampling import sample_tokens
+from ollamamq_tpu.parallel.mesh import make_mesh, validate_tp_for_model
+from ollamamq_tpu.parallel.sharding import kv_cache_spec, shard_params
+
+log = logging.getLogger("ollamamq.engine")
+
+
+class ModelRuntime:
+    """Per-model decode state: KV pool, slot table, compiled step fns."""
+
+    def __init__(
+        self,
+        name: str,
+        model_cfg: ModelConfig,
+        engine_cfg: EngineConfig,
+        mesh=None,
+        checkpoint_path: Optional[str] = None,
+        dtype=jnp.bfloat16,
+    ):
+        self.name = name
+        self.cfg = model_cfg
+        self.ecfg = engine_cfg
+        self.mesh = mesh
+        self.dtype = dtype
+        self.tokenizer = load_tokenizer(checkpoint_path)
+        if mesh is not None and mesh.shape.get("tensor", 1) > 1:
+            validate_tp_for_model(
+                mesh.shape["tensor"], model_cfg.num_kv_heads, model_cfg.num_heads
+            )
+        params = weights.load_params(
+            model_cfg, checkpoint_path, seed=engine_cfg.seed, dtype=dtype
+        )
+        kv_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            params = shard_params(params, mesh)
+            kv_sharding = NamedSharding(mesh, kv_cache_spec())
+        self.params = params
+        self.kc, self.vc = kvc.alloc_kv_pool(model_cfg, engine_cfg, kv_sharding, dtype)
+        self.alloc = kvc.PageAllocator(
+            engine_cfg.num_pages, engine_cfg.page_size, engine_cfg.max_pages_per_seq
+        )
+
+        S, MP = engine_cfg.max_slots, engine_cfg.max_pages_per_seq
+        self.slot_req: List[Optional[Request]] = [None] * S
+        self.slot_pages: List[List[int]] = [[] for _ in range(S)]
+        self.page_table = np.full((S, MP), kvc.TRASH_PAGE, np.int32)
+        self.seq_lens = np.zeros((S,), np.int32)
+        self.last_tokens = np.zeros((S,), np.int32)
+        self.temp = np.zeros((S,), np.float32)
+        self.top_k = np.zeros((S,), np.int32)
+        self.top_p = np.ones((S,), np.float32)
+
+        self.pending_prefill: collections.deque = collections.deque()
+        self._prefill_jits: Dict[int, callable] = {}
+        self._decode_jits: Dict[int, callable] = {}
+        self._rng_counter = engine_cfg.seed
+
+        # Telemetry.
+        self.step_latency_ms = 0.0
+        self.prefill_latency_ms = 0.0
+        self.tokens_generated = 0
+        self.param_bytes = sum(
+            x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(params)
+        )
+        self.kv_bytes = kvc.kv_pool_bytes(
+            model_cfg, engine_cfg, jnp.dtype(dtype).itemsize
+        )
+
+    # -- capacity ----------------------------------------------------------
+    def free_slots(self) -> int:
+        return sum(r is None for r in self.slot_req)
+
+    def has_capacity(self) -> bool:
+        """Can we take one more request from the scheduler right now?"""
+        return (
+            len(self.pending_prefill) < 2 * self.ecfg.max_slots
+            and self.free_slots() > 0
+            and self.alloc.free_pages >= 2
+        )
+
+    def has_work(self) -> bool:
+        return bool(self.pending_prefill) or any(r is not None for r in self.slot_req)
+
+    def active_count(self) -> int:
+        return sum(r is not None for r in self.slot_req)
+
+    # -- submission --------------------------------------------------------
+    def submit(self, req: Request) -> None:
+        req._inc_decode = self.tokenizer.make_incremental_decoder()
+        self.pending_prefill.append(req)
+
+    # -- compiled steps ----------------------------------------------------
+    def _bucket_for(self, n: int) -> int:
+        for b in self.ecfg.prefill_buckets:
+            if n <= b:
+                return b
+        return self.ecfg.prefill_buckets[-1]
+
+    def _next_key(self):
+        self._rng_counter += 1
+        return jax.random.PRNGKey(self._rng_counter)
+
+    def _get_prefill_jit(self, bucket: int):
+        if bucket not in self._prefill_jits:
+            cfg, ps = self.cfg, self.ecfg.page_size
+
+            def fn(params, tokens, seq_lens, kc, vc, pt, temp, tk, tp, key):
+                logits, kc, vc = llama.forward_prefill(
+                    params, cfg, tokens, seq_lens, kc, vc, pt, ps
+                )
+                tok = sample_tokens(logits, key, temp, tk, tp)
+                return tok, kc, vc
+
+            self._prefill_jits[bucket] = jax.jit(fn, donate_argnums=(3, 4))
+        return self._prefill_jits[bucket]
+
+    def _get_decode_jit(self, k_steps: int):
+        if k_steps not in self._decode_jits:
+            cfg, ps = self.cfg, self.ecfg.page_size
+
+            def fn(params, tokens, positions, kc, vc, pt, temp, tk, tp, key):
+                def step(carry, _):
+                    tokens, positions, kc, vc, key = carry
+                    logits, kc, vc = llama.forward_decode(
+                        params, cfg, tokens, positions, kc, vc, pt, ps
+                    )
+                    key, sub = jax.random.split(key)
+                    nxt = sample_tokens(logits, sub, temp, tk, tp)
+                    return (nxt, positions + 1, kc, vc, key), nxt
+
+                (tokens, positions, kc, vc, key), toks = jax.lax.scan(
+                    step, (tokens, positions, kc, vc, key), None, length=k_steps
+                )
+                return toks, kc, vc  # toks: [K, S]
+
+            self._decode_jits[k_steps] = jax.jit(fn, donate_argnums=(3, 4))
+        return self._decode_jits[k_steps]
+
+    # -- slot lifecycle ----------------------------------------------------
+    def _finish_slot(
+        self, slot: int, reason: FinishReason, core: MQCore, flush: bool = True
+    ) -> None:
+        """`flush=False` on the stop-string path: held-back text contains the
+        stop sequence the client asked to suppress."""
+        req = self.slot_req[slot]
+        if req is None:
+            return
+        self.alloc.free(self.slot_pages[slot])
+        self.page_table[slot, :] = kvc.TRASH_PAGE
+        self.seq_lens[slot] = 0
+        self.temp[slot] = 0.0
+        self.top_k[slot] = 0
+        self.top_p[slot] = 1.0
+        self.slot_req[slot] = None
+        req.stats.completion_tokens = len(req.generated_ids)
+        if reason == FinishReason.CANCELLED:
+            core.mark_dropped(req.user)
+        else:
+            if flush:
+                chunk = req.flush_text()
+                if chunk:
+                    req.stream.push(StreamItem("token", text=chunk))
+            core.mark_done(req.user, tokens=len(req.generated_ids))
+        req.finish(reason)
+
+    def _emit_token(self, slot: int, tok: int, core: MQCore) -> bool:
+        """Process one sampled token for a slot. Returns True if seq continues."""
+        req = self.slot_req[slot]
+        if req is None:
+            return False
+        if req.cancelled.is_set() or req.stream.overflowed:
+            # Overflowed stream == consumer stopped reading == client gone.
+            self._finish_slot(slot, FinishReason.CANCELLED, core)
+            return False
+        if tok == self.tokenizer.eos_id:
+            self._finish_slot(slot, FinishReason.STOP, core)
+            return False
+        req.generated_ids.append(tok)
+        if not req.stats.first_token_at:
+            req.stats.first_token_at = time.monotonic()
+        text = req._inc_decode(tok)
+        chunk = req.emit_text(text) if text else ""
+        if chunk is None:  # stop string fired: suppress held-back text
+            self._finish_slot(slot, FinishReason.STOP, core, flush=False)
+            return False
+        if chunk:
+            req.stream.push(StreamItem("token", text=chunk, token_id=tok))
+        if len(req.generated_ids) >= req.sampling.max_tokens:
+            self._finish_slot(slot, FinishReason.LENGTH, core)
+            return False
+        max_ctx = min(self.ecfg.max_context, self.cfg.max_seq_len)
+        if int(self.seq_lens[slot]) + 1 >= max_ctx:
+            self._finish_slot(slot, FinishReason.LENGTH, core)
+            return False
+        return True
+
+    # -- steps -------------------------------------------------------------
+    def step_prefill(self, core: MQCore) -> bool:
+        """Admit one pending request into a free slot. Returns True if ran."""
+        while self.pending_prefill:
+            req = self.pending_prefill[0]
+            if req.cancelled.is_set():
+                self.pending_prefill.popleft()
+                core.mark_dropped(req.user)
+                req.finish(FinishReason.CANCELLED)
+                continue
+            n = len(req.prompt_tokens)
+            bucket = self._bucket_for(n)
+            max_prompt = min(
+                bucket, self.ecfg.max_context - 1, self.cfg.max_seq_len - 1
+            )
+            if n > max_prompt:  # longer than bucket/context/model limit
+                self.pending_prefill.popleft()
+                core.mark_dropped(req.user)  # mark_started ran at admission
+                req.finish(
+                    FinishReason.ERROR,
+                    error=f"prompt length {n} exceeds maximum {max_prompt}",
+                )
+                continue
+            slot = next((i for i, r in enumerate(self.slot_req) if r is None), None)
+            if slot is None:
+                return False
+            pages = self.alloc.alloc(n + 1)
+            if pages is None:
+                return False  # pool exhausted; retry after frees
+            self.pending_prefill.popleft()
+
+            req.stats.prefill_started_at = time.monotonic()
+            self.slot_pages[slot] = pages
+            self.page_table[slot, :] = kvc.make_page_table_row(
+                pages, self.ecfg.max_pages_per_seq
+            )
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :n] = req.prompt_tokens
+            s = req.sampling
+            t0 = time.monotonic()
+            fn = self._get_prefill_jit(bucket)
+            tok, self.kc, self.vc = fn(
+                self.params,
+                jnp.asarray(tokens),
+                jnp.asarray([n], jnp.int32),
+                self.kc,
+                self.vc,
+                jnp.asarray(self.page_table[slot : slot + 1]),
+                jnp.asarray([s.temperature], jnp.float32),
+                jnp.asarray([s.top_k], jnp.int32),
+                jnp.asarray([s.top_p], jnp.float32),
+                self._next_key(),
+            )
+            tok = int(np.asarray(tok)[0])
+            self.prefill_latency_ms = (time.monotonic() - t0) * 1e3
+
+            self.slot_req[slot] = req
+            self.seq_lens[slot] = n
+            self.temp[slot] = s.temperature
+            self.top_k[slot] = s.top_k
+            self.top_p[slot] = s.top_p
+            self.tokens_generated += 1
+            if self._emit_token(slot, tok, core):
+                # Token written at position n during the next decode step.
+                self.last_tokens[slot] = tok
+                self.seq_lens[slot] = n  # decode will write at pos n
+            return True
+        return False
+
+    def step_decode(self, core: MQCore, k_steps: int = 1) -> int:
+        """Advance all active slots by up to k_steps tokens. Returns #tokens."""
+        active = [i for i, r in enumerate(self.slot_req) if r is not None]
+        if not active:
+            return 0
+        # Ensure page headroom for k_steps new tokens per active slot.
+        for i in active:
+            need = int(self.seq_lens[i]) + k_steps
+            if not self.alloc.extend(self.slot_pages[i], need):
+                # Pool exhausted or per-seq cap: end this sequence here.
+                self._finish_slot(i, FinishReason.LENGTH, core)
+            else:
+                self.page_table[i, :] = kvc.make_page_table_row(
+                    self.slot_pages[i], self.ecfg.max_pages_per_seq
+                )
+        active = [i for i, r in enumerate(self.slot_req) if r is not None]
+        if not active:
+            return 0
+
+        t0 = time.monotonic()
+        fn = self._get_decode_jit(k_steps)
+        toks, self.kc, self.vc = fn(
+            self.params,
+            jnp.asarray(self.last_tokens),
+            jnp.asarray(self.seq_lens),  # position of the incoming token
+            self.kc,
+            self.vc,
+            jnp.asarray(self.page_table),
+            jnp.asarray(self.temp),
+            jnp.asarray(self.top_k),
+            jnp.asarray(self.top_p),
+            self._next_key(),
+        )
+        toks = np.asarray(toks)  # [K, S]
+        self.step_latency_ms = (time.monotonic() - t0) * 1e3 / k_steps
+
+        emitted = 0
+        for k in range(k_steps):
+            for i in active:
+                if self.slot_req[i] is None:
+                    continue  # finished at an earlier k
+                tok = int(toks[k, i])
+                self.seq_lens[i] += 1
+                self.tokens_generated += 1
+                emitted += 1
+                if self._emit_token(i, tok, core):
+                    self.last_tokens[i] = tok
+        return emitted
+
+    def check_cancellations(self, core: MQCore) -> None:
+        for i, req in enumerate(self.slot_req):
+            if req is not None and req.cancelled.is_set():
+                self._finish_slot(i, FinishReason.CANCELLED, core)
+
+    def stats(self) -> dict:
+        return {
+            "model": self.name,
+            "active_slots": self.active_count(),
+            "max_slots": self.ecfg.max_slots,
+            "pending_prefill": len(self.pending_prefill),
+            "pages_used": self.alloc.used_pages,
+            "pages_total": self.alloc.num_pages - 1,
+            "step_latency_ms": round(self.step_latency_ms, 3),
+            "prefill_latency_ms": round(self.prefill_latency_ms, 3),
+            "tokens_generated": self.tokens_generated,
+            "param_bytes": self.param_bytes,
+            "kv_bytes": self.kv_bytes,
+        }
+
+
+class EncoderRuntime:
+    """Embedding model runtime: batch encode, no KV cache."""
+
+    def __init__(self, name, model_cfg, engine_cfg, mesh=None,
+                 checkpoint_path=None, dtype=jnp.bfloat16):
+        self.name = name
+        self.cfg = model_cfg
+        self.ecfg = engine_cfg
+        self.tokenizer = load_tokenizer(checkpoint_path)
+        params = weights.load_params(model_cfg, checkpoint_path,
+                                     seed=engine_cfg.seed, dtype=dtype)
+        if mesh is not None:
+            params = shard_params(params, mesh)
+        self.params = params
+        self.pending: collections.deque = collections.deque()
+        self._jits: Dict[Tuple[int, int], callable] = {}
+        self.param_bytes = sum(
+            x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(params)
+        )
+        self.kv_bytes = 0
+        self.tokens_generated = 0
+        self.step_latency_ms = 0.0
+
+    def has_capacity(self) -> bool:
+        return len(self.pending) < 4 * self.ecfg.max_slots
+
+    def has_work(self) -> bool:
+        return bool(self.pending)
+
+    def active_count(self) -> int:
+        return 0
+
+    def submit(self, req: Request) -> None:
+        self.pending.append(req)
+
+    def check_cancellations(self, core: MQCore) -> None:
+        pass
+
+    def _get_jit(self, batch: int, bucket: int):
+        key = (batch, bucket)
+        if key not in self._jits:
+            cfg = self.cfg
+
+            def fn(params, tokens, seq_lens):
+                return llama.forward_encoder(params, cfg, tokens, seq_lens)
+
+            self._jits[key] = jax.jit(fn)
+        return self._jits[key]
+
+    def step(self, core: MQCore) -> None:
+        """Encode up to 8 pending requests in one padded batch."""
+        batch: List[Request] = []
+        while self.pending and len(batch) < 8:
+            req = self.pending.popleft()
+            if req.cancelled.is_set():
+                core.mark_dropped(req.user)
+                req.finish(FinishReason.CANCELLED)
+                continue
+            batch.append(req)
+        if not batch:
+            return
+        longest = max(len(r.prompt_tokens) for r in batch)
+        bucket = 32
+        while bucket < longest:
+            bucket *= 2
+        B = 8  # fixed batch bucket => one compile
+        tokens = np.zeros((B, bucket), np.int32)
+        lens = np.zeros((B,), np.int32)
+        for i, r in enumerate(batch):
+            tokens[i, : len(r.prompt_tokens)] = r.prompt_tokens
+            lens[i] = len(r.prompt_tokens)
+        t0 = time.monotonic()
+        out = self._get_jit(B, bucket)(
+            self.params, jnp.asarray(tokens), jnp.asarray(lens)
+        )
+        out = np.asarray(out)
+        self.step_latency_ms = (time.monotonic() - t0) * 1e3
+        for i, r in enumerate(batch):
+            r.embedding = out[i].tolist()
+            r.stats.first_token_at = time.monotonic()
+            core.mark_done(r.user, tokens=int(lens[i]))
+            r.finish(FinishReason.STOP)
+
+    def stats(self) -> dict:
+        return {
+            "model": self.name,
+            "active_slots": 0,
+            "max_slots": 0,
+            "pending_prefill": len(self.pending),
+            "pages_used": 0,
+            "pages_total": 0,
+            "step_latency_ms": round(self.step_latency_ms, 3),
+            "prefill_latency_ms": 0.0,
+            "tokens_generated": self.tokens_generated,
+            "param_bytes": self.param_bytes,
+            "kv_bytes": self.kv_bytes,
+        }
+
+
+class TPUEngine:
+    """Engine front: owns the scheduler core, model runtimes, and the loop."""
+
+    def __init__(
+        self,
+        engine_cfg: EngineConfig,
+        models: Optional[Dict[str, Optional[str]]] = None,  # name -> ckpt path
+        blocklist_path: Optional[str] = "blocked_items.json",
+        mesh=None,
+        fairness: Fairness = Fairness.REQUESTS,
+        dtype=None,
+    ):
+        self.ecfg = engine_cfg
+        self.core = MQCore(blocklist_path)
+        self.core.set_fairness(fairness)
+        if mesh is None and (engine_cfg.dp, engine_cfg.sp, engine_cfg.tp) != (1, 1, 1):
+            mesh = make_mesh(dp=engine_cfg.dp, sp=engine_cfg.sp, tp=engine_cfg.tp)
+        self.mesh = mesh
+        self.dtype = dtype if dtype is not None else jnp.dtype(engine_cfg.dtype)
+        self.runtimes: Dict[str, object] = {}
+        self.pending: Dict[int, Request] = {}
+        self._pending_lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self.started_at = time.time()
+        models = models if models is not None else {engine_cfg.model: None}
+        for name, ckpt in models.items():
+            self.load_model(name, ckpt)
+
+    # -- model management (registry-facing; /api/pull and /api/delete) -----
+    def load_model(self, name: str, checkpoint_path: Optional[str] = None) -> None:
+        cfg = get_model_config(name)
+        if cfg is None:
+            raise KeyError(f"unknown model architecture: {name}")
+        if name in self.runtimes:
+            return
+        cls = EncoderRuntime if cfg.is_encoder else ModelRuntime
+        self.runtimes[name] = cls(
+            name, cfg, self.ecfg, mesh=self.mesh,
+            checkpoint_path=checkpoint_path, dtype=self.dtype,
+        )
+        log.info("loaded model %s (%.1f MB params)", name,
+                 self.runtimes[name].param_bytes / 1e6)
+        self.notify()
+
+    def evict_model(self, name: str) -> bool:
+        rt = self.runtimes.get(name)
+        if rt is None:
+            return False
+        if rt.has_work():
+            raise RuntimeError(f"model {name} has in-flight work")
+        del self.runtimes[name]
+        return True
+
+    def loaded_models(self) -> List[str]:
+        return list(self.runtimes.keys())
+
+    # -- request flow ------------------------------------------------------
+    def submit(self, req: Request) -> None:
+        """Called by the server AFTER core.enqueue assigned req.req_id."""
+        with self._pending_lock:
+            self.pending[req.req_id] = req
+        self.notify()
+
+    def cancel(self, req_id: int) -> None:
+        with self._pending_lock:
+            req = self.pending.get(req_id)
+        if req is not None:
+            req.cancelled.set()
+            # Still in the native queue (never admitted): remove it there and
+            # finish the stream now — nothing else will ever pop it.
+            if self.core.cancel(req_id):
+                with self._pending_lock:
+                    self.pending.pop(req_id, None)
+                req.finish(FinishReason.CANCELLED)
+            self.notify()
+            return
+        if req is None:
+            # Already admitted: find it in a runtime (active slot or
+            # waiting for prefill).
+            for rt in list(self.runtimes.values()):
+                holders = (
+                    list(getattr(rt, "slot_req", []))
+                    + list(getattr(rt, "active", []))
+                    + list(getattr(rt, "pending_prefill", []))
+                    + list(getattr(rt, "pending", []))
+                )
+                for cand in holders:
+                    if cand is not None and cand.req_id == req_id:
+                        req = cand
+                        break
+                if req is not None:
+                    break
+        if req is not None:
+            req.cancelled.set()
+        else:
+            self.core.cancel(req_id)  # still queued in the native core
+        self.notify()
+
+    def notify(self) -> None:
+        with self._cond:
+            self._cond.notify()
+
+    def resolve_runtime(self, model: str):
+        if not model:
+            # No model requested: any generative runtime (reference lets
+            # Unknown-family tasks hit any backend, dispatcher.rs:453-461).
+            for rt in self.runtimes.values():
+                if isinstance(rt, ModelRuntime):
+                    return rt
+            return next(iter(self.runtimes.values()), None)
+        key = smart_match(model, self.runtimes.keys())
+        return self.runtimes[key] if key is not None else None
+
+    # -- main loop ---------------------------------------------------------
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, name="engine", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        self.notify()
+        if self._thread:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _admit(self) -> int:
+        admitted = 0
+        while True:
+            eligible = [
+                name for name, rt in self.runtimes.items() if rt.has_capacity()
+            ]
+            if not eligible:
+                break
+            try:
+                item = self.core.next(eligible_models=eligible)
+            except StuckQueue:
+                break  # policy pick unservable; cursor advanced, retry on wake
+            if item is None:
+                break
+            rid, user, model = item
+            with self._pending_lock:
+                req = self.pending.pop(rid, None)
+            if req is None:
+                # Enqueued but never registered (shouldn't happen) — drop.
+                self.core.mark_dropped(user, started=False)
+                continue
+            if req.cancelled.is_set():  # late re-check (dispatcher.rs:503-512)
+                self.core.mark_dropped(user, started=False)
+                req.finish(FinishReason.CANCELLED)
+                continue
+            rt = self.resolve_runtime(model)
+            if rt is None:
+                self.core.mark_dropped(user, started=False)
+                req.finish(FinishReason.ERROR, error=f"model not loaded: {model}")
+                continue
+            self.core.mark_started(user)
+            rt.submit(req)
+            admitted += 1
+        return admitted
+
+    def _loop(self) -> None:
+        while self._running:
+            self._admit()
+            did_work = False
+            for rt in list(self.runtimes.values()):
+                try:
+                    rt.check_cancellations(self.core)
+                    if isinstance(rt, ModelRuntime):
+                        # TTFT first: drain pending prefills into free slots.
+                        while rt.pending_prefill and rt.step_prefill(self.core):
+                            did_work = True
+                        if any(r is not None for r in rt.slot_req):
+                            more_waiting = bool(rt.pending_prefill) or bool(
+                                self.core.total_queued()
+                            )
+                            k = 1 if more_waiting else self.ecfg.decode_steps_per_iter
+                            rt.step_decode(self.core, k_steps=k)
+                            did_work = True
+                    else:
+                        if rt.has_work():
+                            rt.step(self.core)
+                            did_work = True
+                except Exception:
+                    # A runtime failure must not kill the engine loop: fail
+                    # every request this runtime holds and keep serving the
+                    # rest (reference analogue: an errored dispatch returns
+                    # 500 and counts dropped, dispatcher.rs:555-559).
+                    log.exception("runtime %s step failed", rt.name)
+                    self._fail_runtime(rt, "engine step failed")
+                    did_work = True
+            if not did_work:
+                with self._cond:
+                    self._cond.wait(timeout=0.05)
+
+    def _fail_runtime(self, rt, msg: str) -> None:
+        """Fail all requests held by a runtime after an unrecoverable error."""
+        try:
+            if isinstance(rt, ModelRuntime):
+                for i, req in enumerate(rt.slot_req):
+                    if req is not None:
+                        rt.alloc.free(rt.slot_pages[i])
+                        rt.page_table[i, :] = kvc.TRASH_PAGE
+                        rt.seq_lens[i] = 0
+                        rt.slot_req[i] = None
+                        self.core.mark_dropped(req.user)
+                        req.finish(FinishReason.ERROR, error=msg)
+            pending = getattr(rt, "pending_prefill", None) or getattr(rt, "pending", [])
+            while pending:
+                req = pending.popleft()
+                self.core.mark_dropped(req.user)
+                req.finish(FinishReason.ERROR, error=msg)
+        except Exception:
+            log.exception("error while failing runtime %s", rt.name)
+
+    # -- telemetry ---------------------------------------------------------
+    def stats(self) -> dict:
+        runtime_stats = [rt.stats() for rt in self.runtimes.values()]
+        hbm_used = sum(r["param_bytes"] + r["kv_bytes"] for r in runtime_stats)
+        hbm_total = None
+        try:
+            ms = jax.local_devices()[0].memory_stats()
+            if ms:
+                hbm_used = ms.get("bytes_in_use", hbm_used)
+                hbm_total = ms.get("bytes_limit")
+        except Exception:
+            pass
+        return {
+            "runtimes": runtime_stats,
+            "hbm_used_bytes": hbm_used,
+            "hbm_total_bytes": hbm_total,
+            "devices": [str(d) for d in jax.devices()],
+            "uptime_s": round(time.time() - self.started_at, 1),
+            "queue": self.core.snapshot(),
+        }

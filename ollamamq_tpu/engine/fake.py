@@ -1,0 +1,168 @@
+"""Deterministic fake engine for tests and API development.
+
+The moral equivalent of the reference's test strategy of pointing the proxy
+at real Ollama servers (SURVEY.md §4): an in-process engine with the same
+interface as TPUEngine but no JAX — tokens are deterministic, latency is
+configurable, cancellation works mid-stream. Lets the full HTTP surface be
+conformance-tested without a TPU in the loop.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Dict, List, Optional
+
+from ollamamq_tpu.config import EngineConfig, get_model_config
+from ollamamq_tpu.engine.engine import TPUEngine
+from ollamamq_tpu.engine.request import FinishReason, Request, StreamItem
+from ollamamq_tpu.engine.tokenizer import ByteTokenizer
+
+
+class FakeRuntime:
+    """Generates `word0 word1 ...` tokens, one per step, per active request."""
+
+    def __init__(self, name: str, engine_cfg: EngineConfig,
+                 token_latency_s: float = 0.0, is_encoder: bool = False):
+        self.name = name
+        self.ecfg = engine_cfg
+        self.token_latency_s = token_latency_s
+        self.is_encoder = is_encoder
+        self.tokenizer = ByteTokenizer()
+        self.pending_prefill: collections.deque = collections.deque()
+        self.active: List[Request] = []
+        self.tokens_generated = 0
+        self.step_latency_ms = 0.0
+        self.prefill_latency_ms = 0.0
+        self.param_bytes = 0
+        self.kv_bytes = 0
+
+    def has_capacity(self) -> bool:
+        return len(self.active) + len(self.pending_prefill) < self.ecfg.max_slots
+
+    def has_work(self) -> bool:
+        return bool(self.pending_prefill) or bool(self.active)
+
+    def active_count(self) -> int:
+        return len(self.active)
+
+    def submit(self, req: Request) -> None:
+        self.pending_prefill.append(req)
+
+    def check_cancellations(self, core) -> None:
+        for req in list(self.active):
+            if req.cancelled.is_set():
+                self.active.remove(req)
+                core.mark_dropped(req.user)
+                req.finish(FinishReason.CANCELLED)
+
+    def step(self, core) -> None:
+        # Admit everything pending (fake engine has no real slot pressure).
+        # NOTE: core.mark_started already ran in TPUEngine._admit.
+        while self.pending_prefill:
+            req = self.pending_prefill.popleft()
+            if req.cancelled.is_set():
+                core.mark_dropped(req.user)
+                req.finish(FinishReason.CANCELLED)
+                continue
+            if self.is_encoder or req.kind == "embed":
+                req.embedding = self._fake_embedding(req)
+                req.stats.first_token_at = time.monotonic()
+                core.mark_done(req.user, tokens=len(req.prompt_tokens))
+                req.finish(FinishReason.STOP)
+            else:
+                req._fake_remaining = min(req.sampling.max_tokens, 16)
+                req._fake_idx = 0
+                self.active.append(req)
+        if self.token_latency_s:
+            time.sleep(self.token_latency_s)
+        for req in list(self.active):
+            if req.cancelled.is_set():
+                self.active.remove(req)
+                core.mark_dropped(req.user)
+                req.finish(FinishReason.CANCELLED)
+                continue
+            word = f"word{req._fake_idx} "
+            req._fake_idx += 1
+            req._fake_remaining -= 1
+            req.generated_ids.append(req._fake_idx)
+            self.tokens_generated += 1
+            if not req.stats.first_token_at:
+                req.stats.first_token_at = time.monotonic()
+            chunk = req.emit_text(word)
+            if chunk is None:
+                self.active.remove(req)
+                core.mark_done(req.user, tokens=len(req.generated_ids))
+                req.stats.completion_tokens = len(req.generated_ids)
+                req.finish(FinishReason.STOP)
+                continue
+            if chunk:
+                req.stream.push(StreamItem("token", text=chunk))
+            if req._fake_remaining <= 0:
+                self.active.remove(req)
+                tail = req.flush_text()
+                if tail:
+                    req.stream.push(StreamItem("token", text=tail))
+                core.mark_done(req.user, tokens=len(req.generated_ids))
+                req.stats.completion_tokens = len(req.generated_ids)
+                req.finish(FinishReason.LENGTH)
+
+    def _fake_embedding(self, req: Request) -> list:
+        # Deterministic unit vector derived from the prompt bytes.
+        dim = 64
+        v = [0.0] * dim
+        for i, t in enumerate(req.prompt_tokens):
+            v[i % dim] += float((t % 13) + 1)
+        norm = sum(x * x for x in v) ** 0.5 or 1.0
+        return [x / norm for x in v]
+
+    def stats(self) -> dict:
+        return {
+            "model": self.name,
+            "active_slots": len(self.active),
+            "max_slots": self.ecfg.max_slots,
+            "pending_prefill": len(self.pending_prefill),
+            "pages_used": 0,
+            "pages_total": 0,
+            "step_latency_ms": round(self.token_latency_s * 1e3, 3),
+            "prefill_latency_ms": 0.0,
+            "tokens_generated": self.tokens_generated,
+            "param_bytes": self.param_bytes,
+            "kv_bytes": self.kv_bytes,
+        }
+
+
+class FakeEngine(TPUEngine):
+    """TPUEngine with FakeRuntimes — identical scheduler/admission path."""
+
+    def __init__(self, engine_cfg: Optional[EngineConfig] = None,
+                 models: Optional[Dict[str, Optional[str]]] = None,
+                 blocklist_path: Optional[str] = None,
+                 token_latency_s: float = 0.0, **kw):
+        self.token_latency_s = token_latency_s
+        engine_cfg = engine_cfg or EngineConfig(model="test-tiny")
+        super().__init__(engine_cfg, models=models,
+                         blocklist_path=blocklist_path, mesh=None, **kw)
+
+    def load_model(self, name: str, checkpoint_path: Optional[str] = None) -> None:
+        if name in self.runtimes:
+            return
+        cfg = get_model_config(name)
+        is_enc = bool(cfg and cfg.is_encoder)
+        self.runtimes[name] = FakeRuntime(
+            name, self.ecfg, token_latency_s=self.token_latency_s, is_encoder=is_enc
+        )
+        self.notify()
+
+    def _loop(self) -> None:
+        while self._running:
+            self._admit()
+            did_work = False
+            for rt in list(self.runtimes.values()):
+                rt.check_cancellations(self.core)
+                if rt.has_work():
+                    rt.step(self.core)
+                    did_work = True
+            if not did_work:
+                with self._cond:
+                    self._cond.wait(timeout=0.02)

@@ -52,9 +52,10 @@ class PageAllocator:
         return self.pages_needed(num_tokens) <= len(self._free)
 
     def alloc(self, num_tokens: int) -> Optional[List[int]]:
-        """Allocate pages to hold num_tokens; None if pool exhausted."""
+        """Allocate pages to hold num_tokens; None if pool exhausted or the
+        request exceeds the per-sequence page cap."""
         n = self.pages_needed(num_tokens)
-        if n > len(self._free):
+        if n > len(self._free) or n > self.max_pages_per_seq:
             return None
         return [self._free.pop() for _ in range(n)]
 

@@ -1,0 +1,195 @@
+"""Request objects and token streams.
+
+A Request is the engine-side unit of work — the analogue of the reference's
+`Task` (/root/reference/src/dispatcher.rs:33-40), but carrying tokenized
+prompts and sampling params instead of opaque HTTP bodies. The TokenStream
+replaces the 32-deep mpsc responder channel (dispatcher.rs:617): the engine
+thread pushes items into a thread-safe queue; an optional callback lets the
+asyncio server mirror items into its event loop without the engine knowing
+about asyncio.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import queue
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+from ollamamq_tpu.ops.sampling import SamplingParams
+
+
+class FinishReason(str, enum.Enum):
+    STOP = "stop"          # EOS token or stop string
+    LENGTH = "length"      # max_tokens or context budget hit
+    CANCELLED = "cancelled"  # client disconnected / admin drop
+    ERROR = "error"
+
+
+@dataclasses.dataclass
+class StreamItem:
+    kind: str  # "token" | "done" | "error"
+    text: str = ""
+    token_id: int = -1
+    finish_reason: Optional[FinishReason] = None
+    error: str = ""
+
+
+class TokenStream:
+    """Thread-safe token channel, engine thread -> consumer.
+
+    Backpressure: bounded queue (default 1024 items — generous vs the
+    reference's 32 because items are single tokens, not HTTP chunks).
+    `on_item` (if set) fires after each push, from the engine thread; the
+    server uses it to wake the asyncio loop.
+    """
+
+    def __init__(self, maxsize: int = 1024):
+        self._q: "queue.Queue[StreamItem]" = queue.Queue(maxsize=maxsize)
+        self.on_item: Optional[Callable[[], None]] = None
+        self._closed = False
+        # Set when the consumer stops reading and the queue fills: the engine
+        # treats it as a client disconnect (the reference likewise interprets
+        # a failed channel send as client-gone, dispatcher.rs:537-551). The
+        # engine thread must NEVER block on a slow consumer.
+        self.overflowed = False
+
+    def push(self, item: StreamItem) -> None:
+        if self._closed:
+            return
+        try:
+            self._q.put_nowait(item)
+        except queue.Full:
+            if item.kind in ("done", "error"):
+                # Terminal items must reach the consumer: shed one token.
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    pass
+                try:
+                    self._q.put_nowait(item)
+                except queue.Full:
+                    pass
+                self._closed = True
+            else:
+                self.overflowed = True
+            return
+        if item.kind in ("done", "error"):
+            self._closed = True
+        cb = self.on_item
+        if cb is not None:
+            cb()
+
+    def get(self, timeout: Optional[float] = None) -> Optional[StreamItem]:
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def get_nowait(self) -> Optional[StreamItem]:
+        try:
+            return self._q.get_nowait()
+        except queue.Empty:
+            return None
+
+    def drain(self) -> List[StreamItem]:
+        out = []
+        while (item := self.get_nowait()) is not None:
+            out.append(item)
+        return out
+
+
+@dataclasses.dataclass
+class RequestStats:
+    enqueued_at: float = dataclasses.field(default_factory=time.monotonic)
+    prefill_started_at: float = 0.0
+    first_token_at: float = 0.0
+    finished_at: float = 0.0
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+
+    @property
+    def ttft_ms(self) -> float:
+        if self.first_token_at:
+            return (self.first_token_at - self.enqueued_at) * 1e3
+        return 0.0
+
+    @property
+    def total_duration_s(self) -> float:
+        end = self.finished_at or time.monotonic()
+        return end - self.enqueued_at
+
+
+class Request:
+    """One generation (or embedding) request flowing through the engine."""
+
+    def __init__(
+        self,
+        req_id: int,
+        user: str,
+        model: str,
+        prompt_tokens: Sequence[int],
+        sampling: Optional[SamplingParams] = None,
+        kind: str = "generate",  # "generate" | "embed"
+        raw_prompt: str = "",
+    ):
+        self.req_id = req_id
+        self.user = user
+        self.model = model
+        self.prompt_tokens = list(prompt_tokens)
+        self.sampling = sampling or SamplingParams()
+        self.kind = kind
+        self.raw_prompt = raw_prompt
+        self.stream = TokenStream()
+        self.stats = RequestStats(prompt_tokens=len(self.prompt_tokens))
+        self.cancelled = threading.Event()
+        # Generation state (engine-owned):
+        self.generated_ids: List[int] = []
+        self.emitted_len = 0  # chars of detok text already pushed
+        self._detok_text = ""
+        self.embedding: Optional[list] = None
+
+    # -- stop-string handling ---------------------------------------------
+    def emit_text(self, new_text: str) -> Optional[str]:
+        """Accumulate detokenized text, honoring stop strings with hold-back.
+
+        Returns the safe-to-emit chunk (may be ""), or None if a stop string
+        fired (caller should finish the request with reason=STOP).
+        """
+        self._detok_text += new_text
+        stops = self.sampling.stop
+        if stops:
+            for s in stops:
+                idx = self._detok_text.find(s)
+                if idx != -1:
+                    chunk = self._detok_text[self.emitted_len:idx]
+                    self.emitted_len = idx
+                    if chunk:
+                        self.stream.push(StreamItem("token", text=chunk))
+                    return None
+            holdback = max(len(s) for s in stops) - 1
+        else:
+            holdback = 0
+        safe_end = len(self._detok_text) - holdback
+        if safe_end > self.emitted_len:
+            chunk = self._detok_text[self.emitted_len:safe_end]
+            self.emitted_len = safe_end
+            return chunk
+        return ""
+
+    def flush_text(self) -> str:
+        """Emit any held-back text (at finish, when no stop matched)."""
+        chunk = self._detok_text[self.emitted_len:]
+        self.emitted_len = len(self._detok_text)
+        return chunk
+
+    @property
+    def full_text(self) -> str:
+        return self._detok_text[: self.emitted_len]
+
+    def finish(self, reason: FinishReason, error: str = "") -> None:
+        self.stats.finished_at = time.monotonic()
+        kind = "error" if reason == FinishReason.ERROR else "done"
+        self.stream.push(StreamItem(kind, finish_reason=reason, error=error))

@@ -1,0 +1,114 @@
+"""Tokenizers: a deterministic byte-level tokenizer (always available — used
+by tests, the fake engine, and random-weight benches) and an HF
+tokenizer.json wrapper for real checkpoints.
+
+The reference never tokenizes (prompts pass through to Ollama opaquely,
+/root/reference/src/dispatcher.rs:621-625 only reads the "model" field);
+in-tree inference makes tokenization a framework component.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+
+class ByteTokenizer:
+    """Reversible byte-level tokenizer: id = byte + 3; 0=pad, 1=bos, 2=eos.
+
+    vocab_size 259 fits every test config. Incremental decode holds back
+    incomplete UTF-8 tails so streamed chunks never contain mojibake.
+    """
+
+    pad_id = 0
+    bos_id = 1
+    eos_id = 2
+    vocab_size = 259
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = [b + 3 for b in text.encode("utf-8")]
+        return [self.bos_id] + ids if add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i - 3 for i in ids if i >= 3)
+        return data.decode("utf-8", errors="replace")
+
+    def make_incremental_decoder(self):
+        buf = bytearray()
+
+        def step(token_id: int) -> str:
+            # Ids outside the byte range (possible with random-weight models
+            # whose vocab exceeds 259) decode to nothing.
+            if token_id < 3 or token_id >= 259:
+                return ""
+            buf.append(token_id - 3)
+            # Emit the longest prefix that is complete UTF-8.
+            for cut in range(len(buf), max(len(buf) - 4, -1), -1):
+                try:
+                    text = buf[:cut].decode("utf-8")
+                except UnicodeDecodeError:
+                    continue
+                if cut:
+                    del buf[:cut]
+                    return text
+                break
+            return ""
+
+        return step
+
+
+class HFTokenizer:
+    """tokenizers-library wrapper (tokenizer.json from an HF model dir)."""
+
+    def __init__(self, path: str):
+        from tokenizers import Tokenizer
+
+        f = path if path.endswith(".json") else os.path.join(path, "tokenizer.json")
+        self._tok = Tokenizer.from_file(f)
+        self.vocab_size = self._tok.get_vocab_size()
+        self.bos_id = self._first_special(["<|begin_of_text|>", "<s>", "<|im_start|>"])
+        self.eos_id = self._first_special(
+            ["<|eot_id|>", "<|end_of_text|>", "</s>", "<|im_end|>"]
+        )
+        self.pad_id = 0
+
+    def _first_special(self, names) -> int:
+        for n in names:
+            i = self._tok.token_to_id(n)
+            if i is not None:
+                return i
+        return 0
+
+    def encode(self, text: str, add_bos: bool = True) -> List[int]:
+        ids = self._tok.encode(text, add_special_tokens=False).ids
+        return [self.bos_id] + ids if add_bos and self.bos_id else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    def make_incremental_decoder(self):
+        prev_ids: List[int] = []
+        prev_text = ""
+
+        def step(token_id: int) -> str:
+            nonlocal prev_text
+            prev_ids.append(token_id)
+            text = self._tok.decode(prev_ids, skip_special_tokens=True)
+            # The replacement char at the tail means an incomplete multibyte
+            # piece — hold it back until the next token completes it.
+            if text.endswith("�"):
+                return ""
+            new = text[len(prev_text):]
+            prev_text = text
+            return new
+
+        return step
+
+
+def load_tokenizer(model_dir: Optional[str]):
+    """HF tokenizer if the checkpoint dir ships one, else byte-level."""
+    if model_dir:
+        f = os.path.join(model_dir, "tokenizer.json")
+        if os.path.exists(f):
+            return HFTokenizer(f)
+    return ByteTokenizer()

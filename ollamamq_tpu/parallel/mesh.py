@@ -45,9 +45,10 @@ def make_mesh(
         if n % (dp * sp) != 0:
             raise ValueError(f"{n} devices not divisible by dp*sp={dp * sp}")
         tp = n // (dp * sp)
-    if dp * sp * tp != n:
-        raise ValueError(f"dp*sp*tp={dp * sp * tp} != {n} devices")
-    arr = np.asarray(devices).reshape(dp, sp, tp)
+    k = dp * sp * tp
+    if k > n:
+        raise ValueError(f"dp*sp*tp={k} > {n} available devices")
+    arr = np.asarray(devices[:k]).reshape(dp, sp, tp)
     return Mesh(arr, (AXIS_DATA, AXIS_SEQ, AXIS_TENSOR))
 
 

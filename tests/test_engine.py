@@ -1,0 +1,299 @@
+"""Continuous-batching engine: end-to-end generation, batching, cancellation."""
+
+import time
+
+import pytest
+
+from ollamamq_tpu.config import EngineConfig
+from ollamamq_tpu.engine.engine import TPUEngine
+from ollamamq_tpu.engine.fake import FakeEngine
+from ollamamq_tpu.engine.request import FinishReason, Request
+from ollamamq_tpu.ops.sampling import SamplingParams
+
+
+def small_cfg(**kw):
+    defaults = dict(
+        model="test-tiny", max_slots=4, num_pages=64, page_size=8,
+        max_pages_per_seq=16, prefill_buckets=(16, 32, 64),
+        max_new_tokens=8, decode_steps_per_iter=4,
+    )
+    defaults.update(kw)
+    return EngineConfig(**defaults)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = TPUEngine(small_cfg(), blocklist_path=None)
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def run_request(eng, user="u", model="test-tiny", prompt="hello world",
+                max_tokens=8, stop=(), timeout=60):
+    tok = eng.runtimes[next(iter(eng.runtimes))].tokenizer
+    rid = eng.core.enqueue(user, "127.0.0.1", model)
+    req = Request(rid, user, model, tok.encode(prompt),
+                  SamplingParams(max_tokens=max_tokens, stop=tuple(stop)))
+    eng.submit(req)
+    return collect(req, timeout), req
+
+
+def collect(req, timeout=60):
+    items, deadline = [], time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        item = req.stream.get(timeout=0.2)
+        if item is None:
+            continue
+        items.append(item)
+        if item.kind in ("done", "error"):
+            return items
+    raise TimeoutError(f"request {req.req_id} did not finish; got {items}")
+
+
+def test_generate_end_to_end(engine):
+    items, req = run_request(engine, prompt="abc", max_tokens=6)
+    assert items[-1].kind == "done"
+    assert items[-1].finish_reason in (FinishReason.LENGTH, FinishReason.STOP)
+    assert len(req.generated_ids) <= 6
+    assert req.stats.ttft_ms > 0
+    # All pages reclaimed after finish.
+    rt = engine.runtimes["test-tiny"]
+    assert rt.active_count() == 0
+
+
+def test_deterministic_greedy(engine):
+    i1, r1 = run_request(engine, prompt="determinism", max_tokens=5)
+    i2, r2 = run_request(engine, prompt="determinism", max_tokens=5)
+    assert r1.generated_ids == r2.generated_ids  # greedy => identical
+
+
+def test_concurrent_requests_share_batch(engine):
+    """Multiple in-flight requests are decoded together (continuous batching)."""
+    tok = engine.runtimes["test-tiny"].tokenizer
+    reqs = []
+    for i in range(4):
+        user = f"user{i}"
+        rid = engine.core.enqueue(user, "", "test-tiny")
+        req = Request(rid, user, "test-tiny", tok.encode(f"prompt {i}"),
+                      SamplingParams(max_tokens=12))
+        reqs.append(req)
+    for r in reqs:
+        engine.submit(r)
+    for r in reqs:
+        items = collect(r)
+        assert items[-1].kind == "done"
+        assert len(r.generated_ids) <= 12
+    snap = engine.core.snapshot()
+    for i in range(4):
+        assert snap["users"][f"user{i}"]["processed"] >= 1
+
+
+def test_cancellation_reclaims_pages():
+    # Dedicated engine with a long context so generation is still in flight
+    # when the cancel lands (the shared engine's 128-token ctx drains too
+    # fast on CPU).
+    eng = TPUEngine(
+        small_cfg(num_pages=512, max_pages_per_seq=128, decode_steps_per_iter=1),
+        blocklist_path=None,
+    )
+    eng.start()
+    try:
+        rt = eng.runtimes["test-tiny"]
+        rt.tokenizer.eos_id = -1  # never sample EOS: keep the seq running
+        free_before = rt.alloc.free_pages
+        tok = rt.tokenizer
+        rid = eng.core.enqueue("canceller", "", "test-tiny")
+        req = Request(rid, "canceller", "test-tiny", tok.encode("to be cancelled"),
+                      SamplingParams(max_tokens=10_000))
+        eng.submit(req)
+        # Wait until it's actually generating, then cancel.
+        deadline = time.monotonic() + 60
+        while not req.stats.first_token_at and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert req.stats.first_token_at, "never started generating"
+        eng.cancel(rid)
+        items = collect(req)
+        assert items[-1].finish_reason == FinishReason.CANCELLED
+        deadline = time.monotonic() + 10
+        while rt.alloc.free_pages < free_before and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert rt.alloc.free_pages == free_before  # KV pages reclaimed
+        snap = eng.core.snapshot()
+        assert snap["users"]["canceller"]["dropped"] >= 1
+    finally:
+        eng.stop()
+
+
+def test_cancel_while_queued(engine):
+    """Cancel before admission: dropped, never prefilled (late re-check)."""
+    tok = engine.runtimes["test-tiny"].tokenizer
+    rid = engine.core.enqueue("early-cancel", "", "test-tiny")
+    req = Request(rid, "early-cancel", "test-tiny", tok.encode("x"))
+    req.cancelled.set()
+    engine.submit(req)
+    items = collect(req)
+    assert items[-1].finish_reason == FinishReason.CANCELLED
+    assert req.generated_ids == []
+
+
+def test_unknown_model_stuck_then_cancelled(engine):
+    """A request for an unloaded model waits in queue rather than failing
+    ("stuck in queue", dispatcher.rs:467-473); cancel drains it."""
+    tok = engine.runtimes["test-tiny"].tokenizer
+    rid = engine.core.enqueue("stuck-user", "", "no-such-model")
+    req = Request(rid, "stuck-user", "no-such-model", tok.encode("hi"))
+    engine.submit(req)
+    time.sleep(0.3)  # give the engine loop time — it must NOT serve this
+    assert req.stream.get_nowait() is None
+    snap = engine.core.snapshot()
+    assert snap["users"]["stuck-user"]["queued"] == 1
+    engine.cancel(rid)
+    items = collect(req, timeout=10)
+    assert items[-1].finish_reason == FinishReason.CANCELLED
+
+
+def test_prompt_too_long_errors(engine):
+    items, req = run_request(engine, prompt="x" * 500)  # > largest bucket 64
+    assert items[-1].kind == "error"
+    assert "exceeds" in items[-1].error
+
+
+def test_max_context_finishes_length(engine):
+    items, req = run_request(engine, prompt="ctx", max_tokens=10_000)
+    assert items[-1].kind == "done"
+    assert items[-1].finish_reason == FinishReason.LENGTH
+    # max context = min(max_pages_per_seq*page_size, model max) = 128
+    assert len(req.prompt_tokens) + len(req.generated_ids) <= 128 + 1
+
+
+def test_fake_engine_stream_and_embed():
+    eng = FakeEngine(small_cfg(), models={"test-tiny": None})
+    eng.start()
+    try:
+        rid = eng.core.enqueue("u", "", "test-tiny")
+        tok = eng.runtimes["test-tiny"].tokenizer
+        req = Request(rid, "u", "test-tiny", tok.encode("hi"),
+                      SamplingParams(max_tokens=5))
+        eng.submit(req)
+        items = collect(req, timeout=10)
+        text = "".join(i.text for i in items if i.kind == "token")
+        assert text == "word0 word1 word2 word3 word4 "
+        assert items[-1].kind == "done"
+
+        rid2 = eng.core.enqueue("u", "", "test-tiny")
+        req2 = Request(rid2, "u", "test-tiny", tok.encode("embed me"), kind="embed")
+        eng.submit(req2)
+        collect(req2, timeout=10)
+        assert req2.embedding is not None
+        assert abs(sum(x * x for x in req2.embedding) - 1.0) < 1e-6
+    finally:
+        eng.stop()
+
+
+def test_fake_engine_stop_string():
+    eng = FakeEngine(small_cfg(), models={"test-tiny": None})
+    eng.start()
+    try:
+        tok = eng.runtimes["test-tiny"].tokenizer
+        rid = eng.core.enqueue("u", "", "test-tiny")
+        req = Request(rid, "u", "test-tiny", tok.encode("hi"),
+                      SamplingParams(max_tokens=16, stop=("word3",)))
+        eng.submit(req)
+        items = collect(req, timeout=10)
+        text = "".join(i.text for i in items if i.kind == "token")
+        assert text == "word0 word1 word2 "
+        assert items[-1].finish_reason == FinishReason.STOP
+    finally:
+        eng.stop()
+
+
+def test_vip_priority_through_engine():
+    """VIP user's requests jump the queue end-to-end (slow fake engine)."""
+    eng = FakeEngine(small_cfg(max_slots=1), models={"test-tiny": None},
+                     token_latency_s=0.01)
+    eng.start()
+    try:
+        tok = eng.runtimes["test-tiny"].tokenizer
+        eng.core.set_vip("vip")
+        order = []
+        reqs = []
+        for user in ("a", "b", "vip", "c"):
+            rid = eng.core.enqueue(user, "", "test-tiny")
+            req = Request(rid, user, "test-tiny", tok.encode(user),
+                          SamplingParams(max_tokens=2))
+            reqs.append((user, req))
+        for _, r in reqs:
+            eng.submit(r)
+        for user, r in reqs:
+            collect(r, timeout=20)
+            order.append((user, r.stats.first_token_at))
+        by_start = [u for u, _ in sorted(order, key=lambda x: x[1])]
+        assert by_start[0] == "vip"
+    finally:
+        eng.stop()
+
+
+def test_oversized_prompt_rejected_cleanly(engine):
+    """A prompt over max_context must error its own request only — no page
+    leak, no collateral damage to other requests (code-review regression)."""
+    rt = engine.runtimes["test-tiny"]
+    free_before = rt.alloc.free_pages
+    # 200 tokens: fits the shared engine's largest bucket (64)? No — but use
+    # a prompt that fits the bucket yet exceeds max_context if possible;
+    # here max_context=128 > bucket 64, so the bucket check fires. Both
+    # paths must produce a clean ERROR.
+    items, req = run_request(engine, prompt="y" * 300)
+    assert items[-1].kind == "error"
+    assert rt.alloc.free_pages == free_before
+    # Engine still serves new work afterwards.
+    items2, _ = run_request(engine, prompt="ok", max_tokens=3)
+    assert items2[-1].kind == "done"
+
+
+def test_stream_overflow_treated_as_disconnect():
+    """A consumer that never reads must not wedge the engine (bounded
+    stream; overflow == client-gone)."""
+    from ollamamq_tpu.engine.request import TokenStream, StreamItem
+
+    s = TokenStream(maxsize=4)
+    for i in range(10):
+        s.push(StreamItem("token", text=f"t{i}"))
+    assert s.overflowed
+    s.push(StreamItem("done"))
+    items = s.drain()
+    assert items[-1].kind == "done"  # terminal item still delivered
+
+
+def test_processing_gauge_not_corrupted_by_precancel():
+    """Dropping a never-started request must not decrement another
+    request's processing count (code-review regression)."""
+    eng = FakeEngine(small_cfg(), models={"test-tiny": None}, token_latency_s=0.05)
+    eng.start()
+    try:
+        tok = eng.runtimes["test-tiny"].tokenizer
+        # One long-running request...
+        rid1 = eng.core.enqueue("gauge-user", "", "test-tiny")
+        r1 = Request(rid1, "gauge-user", "test-tiny", tok.encode("a"),
+                     SamplingParams(max_tokens=16))
+        eng.submit(r1)
+        deadline = time.monotonic() + 10
+        while not r1.stats.first_token_at and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # ...and a second one cancelled before admission.
+        rid2 = eng.core.enqueue("gauge-user", "", "test-tiny")
+        r2 = Request(rid2, "gauge-user", "test-tiny", tok.encode("b"))
+        r2.cancelled.set()
+        eng.submit(r2)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if any(i.kind in ("done", "error") for i in r2.stream.drain()):
+                break
+            time.sleep(0.01)
+        snap = eng.core.snapshot()
+        u = snap["users"]["gauge-user"]
+        assert u["processing"] == 1  # r1 still counted as processing
+        assert u["dropped"] == 1
+        collect(r1, timeout=20)
+    finally:
+        eng.stop()
