@@ -1,0 +1,16 @@
+"""Admin TUI (placeholder — full curses dashboard lands with the admin
+milestone). `run_tui` blocks until quit, mirroring the reference's
+tui_loop on the main thread (main.rs:162-188)."""
+
+from __future__ import annotations
+
+import time
+
+
+def run_tui(engine, registry) -> None:
+    print("TUI not yet implemented; running headless. Ctrl-C to exit.")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        pass

@@ -1,0 +1,152 @@
+"""CLI entrypoint: `python -m ollamamq_tpu.cli`.
+
+Flag parity with the reference CLI (/root/reference/src/main.rs:19-41),
+re-targeted at TPU: `--backend-urls` becomes `--models` (the pool being
+scheduled is model runtimes on TPU chips, not HTTP backends). Logging
+mirrors main.rs:62-87: file appender when the TUI owns the terminal,
+stdout otherwise, level from OLLAMAMQ_LOG (the RUST_LOG analogue).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ollamamq-tpu",
+        description="TPU-native LLM serving with per-user fair-share queuing",
+    )
+    p.add_argument("--port", type=int, default=int(os.environ.get("PORT", 11434)),
+                   help="HTTP port (default 11434)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--models", default=os.environ.get("MODELS", "llama3:8b"),
+                   help="comma-separated model names to load at startup "
+                        "(replaces the reference's --backend-urls)")
+    p.add_argument("--checkpoints", default=os.environ.get("CHECKPOINTS", ""),
+                   help="comma-separated name=path checkpoint mappings; "
+                        "models without one use random weights")
+    p.add_argument("--timeout", type=float,
+                   default=float(os.environ.get("TIMEOUT", 300)),
+                   help="per-request timeout seconds (default 300)")
+    p.add_argument("--no-tui", action="store_true",
+                   help="disable the admin TUI")
+    p.add_argument("--allow-all-routes", action="store_true",
+                   help="expose the fallback route for unhandled paths")
+    p.add_argument("--fake-engine", action="store_true",
+                   help="serve deterministic fake tokens (no TPU; for tests)")
+    p.add_argument("--blocklist", default="blocked_items.json",
+                   help="blocklist persistence path")
+    # Engine shape.
+    p.add_argument("--max-slots", type=int, default=64,
+                   help="decode batch slots (max concurrent generations)")
+    p.add_argument("--num-pages", type=int, default=2048)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--max-pages-per-seq", type=int, default=256)
+    p.add_argument("--max-new-tokens", type=int, default=256)
+    p.add_argument("--decode-steps", type=int, default=8,
+                   help="decode steps fused per dispatch when idle")
+    # Mesh.
+    p.add_argument("--dp", type=int, default=1, help="data-parallel axis size")
+    p.add_argument("--sp", type=int, default=1, help="sequence-parallel axis size")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel axis size (-1 = all devices)")
+    p.add_argument("--token-fairness", action="store_true",
+                   help="fair-share by served tokens instead of request count")
+    return p
+
+
+def setup_logging(use_tui: bool) -> None:
+    level = os.environ.get("OLLAMAMQ_LOG", "INFO").upper()
+    if use_tui:
+        handler = logging.FileHandler("ollamamq.log")
+    else:
+        handler = logging.StreamHandler(sys.stdout)
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s %(name)s: %(message)s"
+    ))
+    logging.basicConfig(level=getattr(logging, level, logging.INFO),
+                        handlers=[handler])
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    use_tui = not args.no_tui and sys.stdout.isatty()
+    setup_logging(use_tui)
+    log = logging.getLogger("ollamamq")
+
+    from ollamamq_tpu.config import EngineConfig
+    from ollamamq_tpu.core import Fairness
+
+    model_names = [m.strip() for m in args.models.split(",") if m.strip()]
+    checkpoints = {}
+    for pair in args.checkpoints.split(","):
+        if "=" in pair:
+            name, path = pair.split("=", 1)
+            checkpoints[name.strip()] = path.strip()
+    models = {name: checkpoints.get(name) for name in model_names}
+
+    ecfg = EngineConfig(
+        model=model_names[0] if model_names else "llama3:8b",
+        max_slots=args.max_slots,
+        num_pages=args.num_pages,
+        page_size=args.page_size,
+        max_pages_per_seq=args.max_pages_per_seq,
+        max_new_tokens=args.max_new_tokens,
+        decode_steps_per_iter=args.decode_steps,
+        dp=args.dp,
+        sp=args.sp,
+        tp=args.tp,
+    )
+    fairness = Fairness.TOKENS if args.token_fairness else Fairness.REQUESTS
+
+    if args.fake_engine:
+        from ollamamq_tpu.engine.fake import FakeEngine
+
+        engine = FakeEngine(ecfg, models=models, blocklist_path=args.blocklist,
+                            fairness=fairness)
+    else:
+        from ollamamq_tpu.engine.engine import TPUEngine
+
+        engine = TPUEngine(ecfg, models=models, blocklist_path=args.blocklist,
+                           fairness=fairness)
+    engine.start()
+
+    from ollamamq_tpu.server.app import Server
+
+    server = Server(engine, timeout_s=args.timeout,
+                    allow_all_routes=args.allow_all_routes)
+    app = server.build_app()
+    log.info("serving %s on %s:%d (tui=%s)", model_names, args.host, args.port, use_tui)
+
+    if use_tui:
+        import threading
+
+        from aiohttp import web as aioweb
+
+        from ollamamq_tpu.admin.tui import run_tui
+
+        # Server on a background thread; TUI owns the terminal (main thread),
+        # like the reference (main.rs:134-150). TUI exit ends the process.
+        def serve():
+            aioweb.run_app(app, host=args.host, port=args.port,
+                           print=None, handle_signals=False)
+
+        t = threading.Thread(target=serve, daemon=True, name="http")
+        t.start()
+        run_tui(engine, server.registry)
+        engine.stop()
+        return 0
+
+    from aiohttp import web as aioweb
+
+    aioweb.run_app(app, host=args.host, port=args.port, print=None)
+    engine.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

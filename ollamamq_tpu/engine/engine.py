@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 
 from ollamamq_tpu.config import EngineConfig, ModelConfig, get_model_config, smart_match
-from ollamamq_tpu.core import MQCore, Fairness
+from ollamamq_tpu.core import MQCore, Fairness, Family
 from ollamamq_tpu.core.mqcore import StuckQueue
 from ollamamq_tpu.engine import kv_cache as kvc
 from ollamamq_tpu.engine.request import FinishReason, Request, StreamItem
@@ -501,6 +501,8 @@ class TPUEngine:
         self.dtype = dtype if dtype is not None else jnp.dtype(engine_cfg.dtype)
         self.runtimes: Dict[str, object] = {}
         self.pending: Dict[int, Request] = {}
+        self._orphans: List[tuple] = []
+        self._expired_orphans: Dict[int, float] = {}
         self._pending_lock = threading.Lock()
         self._cond = threading.Condition()
         self._running = False
@@ -539,8 +541,43 @@ class TPUEngine:
         return list(self.runtimes.keys())
 
     # -- request flow ------------------------------------------------------
+    def enqueue_request(
+        self,
+        user: str,
+        ip: str,
+        model: str,
+        family=None,
+        prompt_tokens=None,
+        sampling=None,
+        kind: str = "generate",
+        raw_prompt: str = "",
+    ) -> Request:
+        """Atomically enqueue into the native core AND register the Request,
+        so the engine loop can never pop a req_id it doesn't know yet.
+        Raises BlockedError for blocked users/IPs."""
+        with self._pending_lock:
+            rid = self.core.enqueue(
+                user, ip, model, family if family is not None else Family.UNKNOWN
+            )
+            req = Request(rid, user, model, prompt_tokens or [], sampling,
+                          kind=kind, raw_prompt=raw_prompt)
+            self.pending[rid] = req
+        self.notify()
+        return req
+
     def submit(self, req: Request) -> None:
-        """Called by the server AFTER core.enqueue assigned req.req_id."""
+        """Register a pre-built Request (req.req_id from core.enqueue).
+        NOTE: prefer enqueue_request — with this two-step flow the engine
+        loop may observe the queued id before registration; _admit tolerates
+        that by parking the id as an orphan, but only enqueue_request is
+        race-free."""
+        if req.req_id in self._expired_orphans:
+            # Its queue slot was already dropped after the orphan grace
+            # period; registering it now would leak it in `pending`.
+            del self._expired_orphans[req.req_id]
+            req.finish(FinishReason.ERROR,
+                       error="request expired before registration")
+            return
         with self._pending_lock:
             self.pending[req.req_id] = req
         self.notify()
@@ -612,6 +649,26 @@ class TPUEngine:
 
     def _admit(self) -> int:
         admitted = 0
+        # Retry orphans: ids popped before their Request was registered
+        # (two-step submit flow); give them a 5 s grace. Placement respects
+        # runtime capacity — an orphan whose runtime is full stays parked.
+        now = time.monotonic()
+        for rid, user, model, ts in list(self._orphans):
+            rt = self.resolve_runtime(model)
+            if rt is not None and not rt.has_capacity():
+                continue
+            with self._pending_lock:
+                req = self.pending.pop(rid, None)
+            if req is not None:
+                self._orphans.remove((rid, user, model, ts))
+                if self._place(req, user, model):
+                    admitted += 1
+            elif now - ts > 5.0:
+                self._orphans.remove((rid, user, model, ts))
+                self.core.mark_dropped(user, started=False)
+                # If the Request shows up via submit() later, fail it
+                # immediately instead of leaking it in `pending` forever.
+                self._expired_orphans[rid] = now
         while True:
             eligible = [
                 name for name, rt in self.runtimes.items() if rt.has_capacity()
@@ -628,22 +685,27 @@ class TPUEngine:
             with self._pending_lock:
                 req = self.pending.pop(rid, None)
             if req is None:
-                # Enqueued but never registered (shouldn't happen) — drop.
-                self.core.mark_dropped(user, started=False)
+                # Popped before registration (legacy two-step submit):
+                # park it and retry for a grace period.
+                self._orphans.append((rid, user, model, time.monotonic()))
                 continue
-            if req.cancelled.is_set():  # late re-check (dispatcher.rs:503-512)
-                self.core.mark_dropped(user, started=False)
-                req.finish(FinishReason.CANCELLED)
-                continue
-            rt = self.resolve_runtime(model)
-            if rt is None:
-                self.core.mark_dropped(user, started=False)
-                req.finish(FinishReason.ERROR, error=f"model not loaded: {model}")
-                continue
-            self.core.mark_started(user)
-            rt.submit(req)
-            admitted += 1
+            if self._place(req, user, model):
+                admitted += 1
         return admitted
+
+    def _place(self, req: Request, user: str, model: str) -> bool:
+        if req.cancelled.is_set():  # late re-check (dispatcher.rs:503-512)
+            self.core.mark_dropped(user, started=False)
+            req.finish(FinishReason.CANCELLED)
+            return False
+        rt = self.resolve_runtime(model)
+        if rt is None:
+            self.core.mark_dropped(user, started=False)
+            req.finish(FinishReason.ERROR, error=f"model not loaded: {model}")
+            return False
+        self.core.mark_started(user)
+        rt.submit(req)
+        return True
 
     def _loop(self) -> None:
         while self._running:

@@ -1,0 +1,609 @@
+"""HTTP API layer: dual Ollama (/api/*) + OpenAI (/v1/*) surface.
+
+Route-for-route parity with the reference router (/root/reference/src/
+main.rs:96-124 — 21 explicit routes + optional fallback, 1 GB body limit),
+but handlers drive the in-tree TPU engine instead of proxying HTTP:
+
+  - `X-User-ID` header keys the fair-share queue; missing => "anonymous"
+    (dispatcher.rs:596-600).
+  - blocked user/IP => 403 at ingress (dispatcher.rs:602-610).
+  - streaming: NDJSON for /api/*, SSE for /v1/* — the wire formats Ollama
+    and OpenAI clients expect; chunks carry tokens from the engine's
+    TokenStream rather than relayed HTTP bytes.
+  - client disconnect mid-stream cancels the request and frees its KV
+    pages (dispatcher.rs:537-551 analogue).
+  - request timeout (default 300 s, main.rs:31-32) cancels and errors.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+import uuid
+from typing import Optional
+
+from aiohttp import web
+
+from ollamamq_tpu import __version__
+from ollamamq_tpu.config import get_model_config
+from ollamamq_tpu.core.mqcore import BlockedError, Family
+from ollamamq_tpu.engine.request import FinishReason, Request, StreamItem
+from ollamamq_tpu.ops.sampling import SamplingParams
+from ollamamq_tpu.server.registry import ModelRegistry
+from ollamamq_tpu.server.templates import render_chat
+
+log = logging.getLogger("ollamamq.server")
+
+MAX_BODY = 1024 * 1024 * 1024  # 1 GB, main.rs:127
+
+
+def _now_iso() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime()) + ".000000000Z"
+
+
+def _ns(seconds: float) -> int:
+    return int(seconds * 1e9)
+
+
+class ApiError(web.HTTPException):
+    def __init__(self, status: int, message: str):
+        self.status_code = status
+        super().__init__(
+            text=json.dumps({"error": message}), content_type="application/json"
+        )
+
+
+class Server:
+    def __init__(self, engine, timeout_s: float = 300.0, allow_all_routes: bool = False):
+        self.engine = engine
+        self.registry = ModelRegistry(engine)
+        self.timeout_s = timeout_s
+        self.allow_all_routes = allow_all_routes
+        self.started_at = time.time()
+
+    # ------------------------------------------------------------------ app
+    def build_app(self) -> web.Application:
+        app = web.Application(client_max_size=MAX_BODY)
+        r = app.router
+        r.add_route("GET", "/health", self.health)
+        r.add_route("*", "/", self.root)
+        r.add_route("*", "/api/generate", self.api_generate)
+        r.add_route("*", "/api/chat", self.api_chat)
+        r.add_route("*", "/api/embed", self.api_embed)
+        r.add_route("*", "/api/embeddings", self.api_embeddings_legacy)
+        r.add_route("*", "/api/tags", self.api_tags)
+        r.add_route("*", "/api/show", self.api_show)
+        r.add_route("*", "/api/create", self.api_create)
+        r.add_route("*", "/api/copy", self.api_copy)
+        r.add_route("*", "/api/delete", self.api_delete)
+        r.add_route("*", "/api/pull", self.api_pull)
+        r.add_route("*", "/api/push", self.api_push)
+        r.add_route("*", "/api/blobs/{digest}", self.api_blobs)
+        r.add_route("*", "/api/ps", self.api_ps)
+        r.add_route("*", "/api/version", self.api_version)
+        r.add_route("*", "/v1/chat/completions", self.v1_chat_completions)
+        r.add_route("*", "/v1/completions", self.v1_completions)
+        r.add_route("*", "/v1/embeddings", self.v1_embeddings)
+        r.add_route("*", "/v1/models", self.v1_models)
+        r.add_route("*", "/v1/models/{model}", self.v1_model)
+        r.add_route("GET", "/metrics", self.metrics)  # TPU-era observability
+        if self.allow_all_routes:
+            r.add_route("*", "/{tail:.*}", self.fallback)
+        return app
+
+    # -------------------------------------------------------------- helpers
+    def _ident(self, request: web.Request):
+        """(user, ip) + ingress block check => 403 (dispatcher.rs:596-610)."""
+        user = request.headers.get("X-User-ID", "anonymous") or "anonymous"
+        ip = request.remote or ""
+        core = self.engine.core
+        if core.is_user_blocked(user):
+            raise ApiError(403, f"user '{user}' is blocked")
+        if ip and core.is_ip_blocked(ip):
+            raise ApiError(403, f"ip '{ip}' is blocked")
+        return user, ip
+
+    async def _body_json(self, request: web.Request) -> dict:
+        if request.method in ("GET", "HEAD"):
+            return {}
+        try:
+            raw = await request.read()
+            if not raw:
+                return {}
+            return json.loads(raw)
+        except json.JSONDecodeError:
+            raise ApiError(400, "invalid JSON body")
+
+    def _resolve_model(self, name: str):
+        if not name:
+            raise ApiError(400, "missing 'model' field")
+        entry = self.registry.resolve(name)
+        if entry is None and get_model_config(name) is None:
+            raise ApiError(404, f"model '{name}' not found")
+        return entry  # may be None: known architecture, not registered
+
+    def _enqueue(self, user, ip, model, family, prompt_tokens, sampling,
+                 kind="generate", raw_prompt="") -> Request:
+        try:
+            return self.engine.enqueue_request(
+                user, ip, model, family, prompt_tokens, sampling,
+                kind=kind, raw_prompt=raw_prompt,
+            )
+        except BlockedError as e:
+            raise ApiError(403, str(e))
+
+    def _tokenize(self, model: str, text: str):
+        rt = self.engine.resolve_runtime(model)
+        if rt is None:
+            # Not loaded: byte-tokenize as a safe default; the request will
+            # wait in queue until the model is pulled anyway.
+            from ollamamq_tpu.engine.tokenizer import ByteTokenizer
+
+            return ByteTokenizer().encode(text)
+        return rt.tokenizer.encode(text)
+
+    async def _collect(self, req: Request) -> list:
+        """Await all stream items (non-streaming responses). A disconnect
+        while waiting cancels the engine-side request."""
+        items = []
+        try:
+            async for item in self._aiter(req):
+                items.append(item)
+        except asyncio.CancelledError:
+            self.engine.cancel(req.req_id)
+            raise
+        return items
+
+    async def _aiter(self, req: Request):
+        """Async iterator over a request's TokenStream with timeout and
+        engine wakeup wiring."""
+        loop = asyncio.get_running_loop()
+        event = asyncio.Event()
+        req.stream.on_item = lambda: loop.call_soon_threadsafe(event.set)
+        deadline = loop.time() + self.timeout_s
+        try:
+            while True:
+                item = req.stream.get_nowait()
+                if item is None:
+                    remaining = deadline - loop.time()
+                    if remaining <= 0:
+                        self.engine.cancel(req.req_id)
+                        yield StreamItem("error", error="request timeout")
+                        return
+                    try:
+                        await asyncio.wait_for(event.wait(), timeout=min(remaining, 1.0))
+                    except asyncio.TimeoutError:
+                        pass
+                    event.clear()
+                    continue
+                yield item
+                if item.kind in ("done", "error"):
+                    return
+        finally:
+            req.stream.on_item = None
+
+    @staticmethod
+    def _done_reason(item: StreamItem) -> str:
+        if item.finish_reason == FinishReason.LENGTH:
+            return "length"
+        return "stop"
+
+    @staticmethod
+    def _gen_stats(req: Request) -> dict:
+        st = req.stats
+        total = st.total_duration_s
+        eval_dur = max(0.0, (st.finished_at or time.monotonic()) - (st.first_token_at or st.enqueued_at))
+        prefill_dur = max(0.0, (st.first_token_at or st.enqueued_at) - st.enqueued_at)
+        return {
+            "total_duration": _ns(total),
+            "load_duration": 0,
+            "prompt_eval_count": st.prompt_tokens,
+            "prompt_eval_duration": _ns(prefill_dur),
+            "eval_count": st.completion_tokens,
+            "eval_duration": _ns(eval_dur),
+        }
+
+    # ------------------------------------------------------------ liveness
+    async def health(self, request: web.Request) -> web.Response:
+        return web.Response(text="OK")
+
+    async def root(self, request: web.Request) -> web.Response:
+        # Ollama answers its root with this exact liveness string; clients
+        # (and the reference's health fallback, dispatcher.rs:363-371) use it.
+        return web.Response(text="Ollama is running")
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        return web.json_response(self.engine.stats())
+
+    # ------------------------------------------------------------- /api/*
+    async def api_generate(self, request: web.Request) -> web.StreamResponse:
+        user, ip = self._ident(request)
+        body = await self._body_json(request)
+        model = body.get("model", "")
+        self._resolve_model(model)
+        prompt = body.get("prompt", "")
+        stream = body.get("stream", True)
+        sampling = SamplingParams.from_ollama_options(
+            body.get("options"), self.engine.ecfg.max_new_tokens
+        )
+        # `images` accepted for wire-compat (multimodal payloads flow through
+        # the queue like test_dispatcher.sh's 5% image traffic); the TPU
+        # engine currently generates from text only.
+        tokens = self._tokenize(model, prompt)
+        req = self._enqueue(user, ip, model, Family.OLLAMA, tokens, sampling,
+                            raw_prompt=prompt)
+
+        if not stream:
+            items = await self._collect(req)
+            return self._ollama_final_response(request, model, req, items, chat=False)
+        return await self._ollama_stream(request, model, req, chat=False)
+
+    async def api_chat(self, request: web.Request) -> web.StreamResponse:
+        user, ip = self._ident(request)
+        body = await self._body_json(request)
+        model = body.get("model", "")
+        self._resolve_model(model)
+        messages = body.get("messages", [])
+        stream = body.get("stream", True)
+        sampling = SamplingParams.from_ollama_options(
+            body.get("options"), self.engine.ecfg.max_new_tokens
+        )
+        entry = self.registry.resolve(model)
+        prompt = render_chat(messages, entry.config if entry else get_model_config(model))
+        tokens = self._tokenize(model, prompt)
+        req = self._enqueue(user, ip, model, Family.OLLAMA, tokens, sampling,
+                            raw_prompt=prompt)
+
+        if not stream:
+            items = await self._collect(req)
+            return self._ollama_final_response(request, model, req, items, chat=True)
+        return await self._ollama_stream(request, model, req, chat=True)
+
+    def _ollama_final_response(self, request, model, req, items, chat: bool):
+        err = next((i for i in items if i.kind == "error"), None)
+        if err is not None:
+            raise ApiError(500, f"engine error: {err.error}")
+        text = "".join(i.text for i in items if i.kind == "token")
+        done = items[-1]
+        payload = {
+            "model": model,
+            "created_at": _now_iso(),
+            "done": True,
+            "done_reason": self._done_reason(done),
+            **self._gen_stats(req),
+        }
+        if chat:
+            payload["message"] = {"role": "assistant", "content": text}
+        else:
+            payload["response"] = text
+        return web.json_response(payload)
+
+    async def _ollama_stream(self, request, model, req, chat: bool):
+        resp = web.StreamResponse()
+        resp.content_type = "application/x-ndjson"
+        await resp.prepare(request)
+
+        def chunk(text):
+            p = {"model": model, "created_at": _now_iso(), "done": False}
+            if chat:
+                p["message"] = {"role": "assistant", "content": text}
+            else:
+                p["response"] = text
+            return (json.dumps(p) + "\n").encode()
+
+        try:
+            async for item in self._aiter(req):
+                if item.kind == "token" and item.text:
+                    await resp.write(chunk(item.text))
+                elif item.kind == "error":
+                    await resp.write((json.dumps(
+                        {"model": model, "created_at": _now_iso(),
+                         "done": True, "done_reason": "error",
+                         "error": item.error}) + "\n").encode())
+                    break
+                elif item.kind == "done":
+                    p = {"model": model, "created_at": _now_iso(), "done": True,
+                         "done_reason": self._done_reason(item),
+                         **self._gen_stats(req)}
+                    if chat:
+                        p["message"] = {"role": "assistant", "content": ""}
+                    else:
+                        p["response"] = ""
+                    await resp.write((json.dumps(p) + "\n").encode())
+                    break
+        except (ConnectionResetError, asyncio.CancelledError):
+            # Client went away mid-stream: cancel + reclaim (dropped count).
+            self.engine.cancel(req.req_id)
+            raise
+        await resp.write_eof()
+        return resp
+
+    # ------------------------------------------------------------ embeddings
+    async def api_embed(self, request: web.Request) -> web.Response:
+        user, ip = self._ident(request)
+        body = await self._body_json(request)
+        model = body.get("model", "")
+        self._resolve_model(model)
+        inputs = body.get("input", "")
+        single = isinstance(inputs, str)
+        texts = [inputs] if single else list(inputs)
+        vectors = await self._embed_batch(user, ip, model, texts)
+        return web.json_response({
+            "model": model,
+            "embeddings": vectors,
+            "total_duration": 0,
+            "load_duration": 0,
+            "prompt_eval_count": sum(len(t) for t in texts),
+        })
+
+    async def api_embeddings_legacy(self, request: web.Request) -> web.Response:
+        user, ip = self._ident(request)
+        body = await self._body_json(request)
+        model = body.get("model", "")
+        self._resolve_model(model)
+        prompt = body.get("prompt", "")
+        vectors = await self._embed_batch(user, ip, model, [prompt])
+        return web.json_response({"embedding": vectors[0] if vectors else []})
+
+    async def _embed_batch(self, user, ip, model, texts):
+        reqs = []
+        for t in texts:
+            tokens = self._tokenize(model, t)
+            req = self._enqueue(user, ip, model, Family.OLLAMA, tokens,
+                                SamplingParams(), kind="embed", raw_prompt=t)
+            reqs.append(req)
+        out = []
+        for req in reqs:
+            items = await self._collect(req)
+            err = next((i for i in items if i.kind == "error"), None)
+            if err is not None:
+                raise ApiError(500, f"engine error: {err.error}")
+            out.append(req.embedding or [])
+        return out
+
+    # --------------------------------------------------------- registry api
+    async def api_tags(self, request: web.Request) -> web.Response:
+        self._ident(request)
+        return web.json_response(self.registry.tags_payload())
+
+    async def api_ps(self, request: web.Request) -> web.Response:
+        self._ident(request)
+        return web.json_response(self.registry.ps_payload())
+
+    async def api_show(self, request: web.Request) -> web.Response:
+        self._ident(request)
+        body = await self._body_json(request)
+        name = body.get("model") or body.get("name") or ""
+        payload = self.registry.show_payload(name)
+        if payload is None:
+            raise ApiError(404, f"model '{name}' not found")
+        return web.json_response(payload)
+
+    async def api_pull(self, request: web.Request) -> web.StreamResponse:
+        self._ident(request)
+        body = await self._body_json(request)
+        name = body.get("model") or body.get("name") or ""
+        stream = body.get("stream", True)
+        if get_model_config(name) is None:
+            raise ApiError(404, f"model '{name}' not found in the registry")
+
+        loop = asyncio.get_running_loop()
+
+        async def do_pull():
+            await loop.run_in_executor(None, self.registry.pull, name)
+
+        if not stream:
+            await do_pull()
+            return web.json_response({"status": "success"})
+        resp = web.StreamResponse()
+        resp.content_type = "application/x-ndjson"
+        await resp.prepare(request)
+        await resp.write((json.dumps({"status": "pulling manifest"}) + "\n").encode())
+        await resp.write((json.dumps(
+            {"status": f"loading {name} into HBM"}) + "\n").encode())
+        await do_pull()
+        await resp.write((json.dumps({"status": "success"}) + "\n").encode())
+        await resp.write_eof()
+        return resp
+
+    async def api_delete(self, request: web.Request) -> web.Response:
+        self._ident(request)
+        body = await self._body_json(request)
+        name = body.get("model") or body.get("name") or ""
+        try:
+            ok = await asyncio.get_running_loop().run_in_executor(
+                None, self.registry.delete, name
+            )
+        except RuntimeError as e:  # model busy (in-flight work)
+            raise ApiError(409, str(e))
+        if not ok:
+            raise ApiError(404, f"model '{name}' not found")
+        return web.json_response({"status": "success"})
+
+    async def api_copy(self, request: web.Request) -> web.Response:
+        self._ident(request)
+        body = await self._body_json(request)
+        src = body.get("source", "")
+        dst = body.get("destination", "")
+        if not src or not dst:
+            raise ApiError(400, "source and destination required")
+        if not self.registry.copy(src, dst):
+            raise ApiError(404, f"model '{src}' not found")
+        return web.json_response({"status": "success"})
+
+    async def api_create(self, request: web.Request) -> web.Response:
+        self._ident(request)
+        raise ApiError(
+            501, "model creation from Modelfiles is not supported; "
+                 "register checkpoints via --checkpoints at startup"
+        )
+
+    async def api_push(self, request: web.Request) -> web.Response:
+        self._ident(request)
+        raise ApiError(501, "pushing models to a remote registry is not supported")
+
+    async def api_blobs(self, request: web.Request) -> web.Response:
+        self._ident(request)
+        raise ApiError(501, "blob upload is not supported on the TPU registry")
+
+    async def api_version(self, request: web.Request) -> web.Response:
+        return web.json_response({"version": __version__})
+
+    # --------------------------------------------------------------- /v1/*
+    async def v1_chat_completions(self, request: web.Request) -> web.StreamResponse:
+        user, ip = self._ident(request)
+        body = await self._body_json(request)
+        model = body.get("model", "")
+        self._resolve_model(model)
+        messages = body.get("messages", [])
+        stream = body.get("stream", False)
+        sampling = SamplingParams.from_openai(body, self.engine.ecfg.max_new_tokens)
+        entry = self.registry.resolve(model)
+        prompt = render_chat(messages, entry.config if entry else get_model_config(model))
+        tokens = self._tokenize(model, prompt)
+        req = self._enqueue(user, ip, model, Family.OPENAI, tokens, sampling,
+                            raw_prompt=prompt)
+        rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+        if stream:
+            return await self._openai_stream(request, model, req, rid, chat=True)
+        items = await self._collect(req)
+        return self._openai_final(model, req, items, rid, chat=True)
+
+    async def v1_completions(self, request: web.Request) -> web.StreamResponse:
+        user, ip = self._ident(request)
+        body = await self._body_json(request)
+        model = body.get("model", "")
+        self._resolve_model(model)
+        prompt = body.get("prompt", "")
+        if isinstance(prompt, list):
+            prompt = prompt[0] if prompt else ""
+        stream = body.get("stream", False)
+        sampling = SamplingParams.from_openai(body, self.engine.ecfg.max_new_tokens)
+        tokens = self._tokenize(model, prompt)
+        req = self._enqueue(user, ip, model, Family.OPENAI, tokens, sampling,
+                            raw_prompt=prompt)
+        rid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        if stream:
+            return await self._openai_stream(request, model, req, rid, chat=False)
+        items = await self._collect(req)
+        return self._openai_final(model, req, items, rid, chat=False)
+
+    def _openai_final(self, model, req, items, rid, chat: bool):
+        err = next((i for i in items if i.kind == "error"), None)
+        if err is not None:
+            raise ApiError(500, f"engine error: {err.error}")
+        text = "".join(i.text for i in items if i.kind == "token")
+        done = items[-1]
+        choice = {"index": 0, "finish_reason": self._done_reason(done)}
+        if chat:
+            choice["message"] = {"role": "assistant", "content": text}
+        else:
+            choice["text"] = text
+        return web.json_response({
+            "id": rid,
+            "object": "chat.completion" if chat else "text_completion",
+            "created": int(time.time()),
+            "model": model,
+            "choices": [choice],
+            "usage": {
+                "prompt_tokens": req.stats.prompt_tokens,
+                "completion_tokens": req.stats.completion_tokens,
+                "total_tokens": req.stats.prompt_tokens + req.stats.completion_tokens,
+            },
+        })
+
+    async def _openai_stream(self, request, model, req, rid, chat: bool):
+        resp = web.StreamResponse()
+        resp.content_type = "text/event-stream"
+        resp.headers["Cache-Control"] = "no-cache"
+        await resp.prepare(request)
+        obj = "chat.completion.chunk" if chat else "text_completion"
+
+        def sse(choice):
+            return (
+                "data: "
+                + json.dumps({
+                    "id": rid, "object": obj, "created": int(time.time()),
+                    "model": model, "choices": [choice],
+                })
+                + "\n\n"
+            ).encode()
+
+        first = True
+        try:
+            async for item in self._aiter(req):
+                if item.kind == "token" and item.text:
+                    if chat:
+                        delta = {"content": item.text}
+                        if first:
+                            delta["role"] = "assistant"
+                            first = False
+                        await resp.write(sse({"index": 0, "delta": delta,
+                                              "finish_reason": None}))
+                    else:
+                        await resp.write(sse({"index": 0, "text": item.text,
+                                              "finish_reason": None}))
+                elif item.kind == "error":
+                    await resp.write(
+                        ("data: " + json.dumps({"error": item.error}) + "\n\n").encode()
+                    )
+                    break
+                elif item.kind == "done":
+                    fin = {"index": 0, "finish_reason": self._done_reason(item)}
+                    if chat:
+                        fin["delta"] = {}
+                    else:
+                        fin["text"] = ""
+                    await resp.write(sse(fin))
+                    await resp.write(b"data: [DONE]\n\n")
+                    break
+        except (ConnectionResetError, asyncio.CancelledError):
+            self.engine.cancel(req.req_id)
+            raise
+        await resp.write_eof()
+        return resp
+
+    async def v1_embeddings(self, request: web.Request) -> web.Response:
+        user, ip = self._ident(request)
+        body = await self._body_json(request)
+        model = body.get("model", "")
+        self._resolve_model(model)
+        inputs = body.get("input", "")
+        texts = [inputs] if isinstance(inputs, str) else list(inputs)
+        vectors = await self._embed_batch(user, ip, model, texts)
+        return web.json_response({
+            "object": "list",
+            "data": [
+                {"object": "embedding", "embedding": v, "index": i}
+                for i, v in enumerate(vectors)
+            ],
+            "model": model,
+            "usage": {"prompt_tokens": sum(len(t) for t in texts),
+                      "total_tokens": sum(len(t) for t in texts)},
+        })
+
+    async def v1_models(self, request: web.Request) -> web.Response:
+        self._ident(request)
+        return web.json_response(self.registry.openai_models_payload())
+
+    async def v1_model(self, request: web.Request) -> web.Response:
+        self._ident(request)
+        name = request.match_info["model"]
+        entry = self.registry.resolve(name)
+        if entry is None:
+            raise ApiError(404, f"model '{name}' not found")
+        return web.json_response({
+            "id": entry.name, "object": "model",
+            "created": int(entry.registered_at), "owned_by": "ollamamq-tpu",
+        })
+
+    async def fallback(self, request: web.Request) -> web.Response:
+        self._ident(request)
+        raise ApiError(
+            501,
+            f"route {request.path} has no TPU-native handler "
+            "(--allow-all-routes only exposes the fallback, there is no "
+            "backend to proxy to)",
+        )

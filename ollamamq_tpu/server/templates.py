@@ -1,0 +1,50 @@
+"""Chat templating: messages -> prompt string.
+
+The reference forwards chat bodies opaquely to Ollama, which applies each
+model's template server-side; with inference in-tree, templating is ours.
+Family-appropriate templates for Llama 3 and Qwen2 (ChatML), plus a plain
+fallback for the byte-tokenizer test models.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ollamamq_tpu.config import ModelConfig
+
+
+def render_chat(messages: List[dict], cfg: Optional[ModelConfig]) -> str:
+    """Render an Ollama/OpenAI-style messages list into a prompt."""
+    msgs = []
+    for m in messages:
+        role = m.get("role", "user")
+        content = m.get("content", "")
+        if isinstance(content, list):  # OpenAI content-part arrays
+            content = "".join(
+                p.get("text", "") for p in content if isinstance(p, dict)
+            )
+        msgs.append((role, content))
+
+    if cfg is not None and cfg.attn_bias:  # Qwen2 family: ChatML
+        out = []
+        for role, content in msgs:
+            out.append(f"<|im_start|>{role}\n{content}<|im_end|>\n")
+        out.append("<|im_start|>assistant\n")
+        return "".join(out)
+
+    if cfg is not None and not cfg.is_encoder and cfg.vocab_size > 100_000:
+        # Llama 3 family header format.
+        out = ["<|begin_of_text|>"]
+        for role, content in msgs:
+            out.append(
+                f"<|start_header_id|>{role}<|end_header_id|>\n\n{content}<|eot_id|>"
+            )
+        out.append("<|start_header_id|>assistant<|end_header_id|>\n\n")
+        return "".join(out)
+
+    # Plain fallback (test models / byte tokenizer).
+    out = []
+    for role, content in msgs:
+        out.append(f"{role}: {content}\n")
+    out.append("assistant: ")
+    return "".join(out)

@@ -1,0 +1,318 @@
+"""API conformance: the 21-route surface, both wire formats, streaming,
+blocking, user identity — driven against the FakeEngine (deterministic
+tokens), mirroring how the reference is black-box tested against live
+Ollama backends (test_dispatcher.sh)."""
+
+import asyncio
+import json
+import tempfile
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from ollamamq_tpu.config import EngineConfig
+from ollamamq_tpu.engine.fake import FakeEngine
+from ollamamq_tpu.server.app import Server
+
+
+def api_test(fn):
+    """Run an async test against a fresh FakeEngine-backed server (no
+    async pytest plugin in the image, so each test owns its event loop)."""
+
+    # NOT functools.wraps: it would expose fn's (client) signature and make
+    # pytest hunt for a 'client' fixture.
+    def wrapper():
+        async def main():
+            with tempfile.TemporaryDirectory() as tmp:
+                eng = FakeEngine(
+                    EngineConfig(model="test-tiny", max_slots=8),
+                    models={"test-tiny": None, "test-tiny-embed": None},
+                    blocklist_path=f"{tmp}/blocked_items.json",
+                )
+                eng.start()
+                server = Server(eng, timeout_s=30)
+                cl = TestClient(TestServer(server.build_app()))
+                cl.engine = eng  # handle for tests that poke the admin surface
+                await cl.start_server()
+                try:
+                    await fn(cl)
+                finally:
+                    await cl.close()
+                    eng.stop()
+
+        asyncio.run(main())
+
+    wrapper.__name__ = fn.__name__
+    wrapper.__doc__ = fn.__doc__
+    return wrapper
+
+
+@api_test
+async def test_health(client):
+    r = await client.get("/health")
+    assert r.status == 200
+    assert await r.text() == "OK"
+
+
+@api_test
+async def test_root_liveness(client):
+    r = await client.get("/")
+    assert r.status == 200
+    assert "running" in await r.text()
+
+
+@api_test
+async def test_generate_non_streaming(client):
+    r = await client.post("/api/generate", json={
+        "model": "test-tiny", "prompt": "hi", "stream": False,
+        "options": {"num_predict": 4},
+    })
+    assert r.status == 200
+    body = await r.json()
+    assert body["model"] == "test-tiny"
+    assert body["done"] is True
+    assert body["response"] == "word0 word1 word2 word3 "
+    assert body["eval_count"] == 4
+    assert body["prompt_eval_count"] > 0
+    assert "total_duration" in body
+
+
+@api_test
+async def test_generate_streaming_ndjson(client):
+    r = await client.post("/api/generate", json={
+        "model": "test-tiny", "prompt": "hi",
+        "options": {"num_predict": 3},
+    })
+    assert r.status == 200
+    assert r.content_type == "application/x-ndjson"
+    lines = [json.loads(l) for l in (await r.text()).strip().split("\n")]
+    assert [c.get("response") for c in lines[:-1]] == ["word0 ", "word1 ", "word2 "]
+    assert all(c["done"] is False for c in lines[:-1])
+    final = lines[-1]
+    assert final["done"] is True and final["done_reason"] in ("stop", "length")
+    assert final["eval_count"] == 3
+
+
+@api_test
+async def test_chat_streaming(client):
+    r = await client.post("/api/chat", json={
+        "model": "test-tiny",
+        "messages": [{"role": "user", "content": "hello"}],
+        "options": {"num_predict": 2},
+    })
+    lines = [json.loads(l) for l in (await r.text()).strip().split("\n")]
+    assert lines[0]["message"]["role"] == "assistant"
+    assert lines[0]["message"]["content"] == "word0 "
+    assert lines[-1]["done"] is True
+
+
+@api_test
+async def test_chat_non_streaming(client):
+    r = await client.post("/api/chat", json={
+        "model": "test-tiny", "stream": False,
+        "messages": [{"role": "user", "content": "hello"}],
+        "options": {"num_predict": 2},
+    })
+    body = await r.json()
+    assert body["message"]["content"] == "word0 word1 "
+
+
+@api_test
+async def test_openai_chat_non_streaming(client):
+    r = await client.post("/v1/chat/completions", json={
+        "model": "test-tiny", "max_tokens": 3,
+        "messages": [{"role": "user", "content": "hello"}],
+    })
+    assert r.status == 200
+    body = await r.json()
+    assert body["object"] == "chat.completion"
+    assert body["choices"][0]["message"]["content"] == "word0 word1 word2 "
+    assert body["choices"][0]["finish_reason"] in ("stop", "length")
+    assert body["usage"]["completion_tokens"] == 3
+
+
+@api_test
+async def test_openai_chat_streaming_sse(client):
+    r = await client.post("/v1/chat/completions", json={
+        "model": "test-tiny", "max_tokens": 2, "stream": True,
+        "messages": [{"role": "user", "content": "hello"}],
+    })
+    assert r.content_type == "text/event-stream"
+    text = await r.text()
+    events = [l[6:] for l in text.split("\n") if l.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    chunks = [json.loads(e) for e in events[:-1]]
+    assert chunks[0]["object"] == "chat.completion.chunk"
+    assert chunks[0]["choices"][0]["delta"]["role"] == "assistant"
+    joined = "".join(c["choices"][0]["delta"].get("content", "") for c in chunks)
+    assert joined == "word0 word1 "
+    assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+
+
+@api_test
+async def test_openai_completions(client):
+    r = await client.post("/v1/completions", json={
+        "model": "test-tiny", "prompt": "once", "max_tokens": 2,
+    })
+    body = await r.json()
+    assert body["object"] == "text_completion"
+    assert body["choices"][0]["text"] == "word0 word1 "
+
+
+@api_test
+async def test_embeddings_all_shapes(client):
+    r = await client.post("/api/embed", json={
+        "model": "test-tiny-embed", "input": ["a", "b"],
+    })
+    body = await r.json()
+    assert len(body["embeddings"]) == 2
+
+    r = await client.post("/api/embeddings", json={
+        "model": "test-tiny-embed", "prompt": "a",
+    })
+    body = await r.json()
+    assert isinstance(body["embedding"], list) and body["embedding"]
+
+    r = await client.post("/v1/embeddings", json={
+        "model": "test-tiny-embed", "input": "a",
+    })
+    body = await r.json()
+    assert body["object"] == "list"
+    assert body["data"][0]["object"] == "embedding"
+
+
+@api_test
+async def test_tags_ps_show_version(client):
+    r = await client.get("/api/tags")
+    tags = await r.json()
+    names = [m["name"] for m in tags["models"]]
+    assert "test-tiny" in names and "test-tiny-embed" in names
+
+    r = await client.get("/api/ps")
+    ps = await r.json()
+    assert any(m["name"] == "test-tiny" for m in ps["models"])
+    assert all("size_vram" in m for m in ps["models"])
+
+    r = await client.post("/api/show", json={"model": "test-tiny"})
+    show = await r.json()
+    assert show["details"]["family"] in ("llama", "qwen2", "bert")
+    assert show["model_info"]["general.architecture"] in ("llama", "qwen2")
+
+    r = await client.get("/api/version")
+    assert "version" in await r.json()
+
+
+@api_test
+async def test_openai_models(client):
+    r = await client.get("/v1/models")
+    body = await r.json()
+    ids = [m["id"] for m in body["data"]]
+    assert "test-tiny" in ids
+    r = await client.get("/v1/models/test-tiny")
+    assert (await r.json())["id"] == "test-tiny"
+    r = await client.get("/v1/models/nope")
+    assert r.status == 404
+
+
+@api_test
+async def test_pull_and_delete_lifecycle(client):
+    # Pull a new architecture into HBM.
+    r = await client.post("/api/pull", json={"model": "test-tiny-qwen", "stream": False})
+    assert r.status == 200
+    r = await client.get("/api/ps")
+    assert any(m["name"] == "test-tiny-qwen" for m in (await r.json())["models"])
+    # Evict it.
+    r = await client.post("/api/delete", json={"model": "test-tiny-qwen"})
+    assert r.status == 200
+    r = await client.get("/api/ps")
+    assert not any(m["name"] == "test-tiny-qwen" for m in (await r.json())["models"])
+
+
+@api_test
+async def test_pull_streaming_progress(client):
+    r = await client.post("/api/pull", json={"model": "test-tiny-qwen"})
+    lines = [json.loads(l) for l in (await r.text()).strip().split("\n")]
+    assert lines[0]["status"] == "pulling manifest"
+    assert lines[-1]["status"] == "success"
+    await client.post("/api/delete", json={"model": "test-tiny-qwen"})
+
+
+@api_test
+async def test_copy_alias(client):
+    r = await client.post("/api/copy", json={
+        "source": "test-tiny", "destination": "my-alias",
+    })
+    assert r.status == 200
+    r = await client.get("/api/tags")
+    assert any(m["name"] == "my-alias" for m in (await r.json())["models"])
+
+
+@api_test
+async def test_unsupported_routes_are_honest(client):
+    assert (await client.post("/api/create", json={})).status == 501
+    assert (await client.post("/api/push", json={})).status == 501
+    assert (await client.post("/api/blobs/sha256:abc", json={})).status == 501
+
+
+@api_test
+async def test_unknown_model_404(client):
+    r = await client.post("/api/generate", json={
+        "model": "definitely-not-a-model", "prompt": "x", "stream": False,
+    })
+    assert r.status == 404
+    assert "not found" in (await r.json())["error"]
+
+
+@api_test
+async def test_missing_model_field_400(client):
+    r = await client.post("/api/generate", json={"prompt": "x"})
+    assert r.status == 400
+
+
+@api_test
+async def test_invalid_json_400(client):
+    r = await client.post("/api/generate", data=b"{not json")
+    assert r.status == 400
+
+
+@api_test
+async def test_block_user_403(client):
+    """Blocked user => 403 at ingress (dispatcher.rs:602-610), and the
+    blocklist round-trips through /metrics; unblock restores service."""
+    core = client.engine.core
+    core.block_user("mallory")
+    r = await client.post("/api/generate", json={
+        "model": "test-tiny", "prompt": "x", "stream": False,
+    }, headers={"X-User-ID": "mallory"})
+    assert r.status == 403
+    assert "blocked" in (await r.json())["error"]
+    # Even non-generation routes refuse blocked users.
+    r = await client.get("/api/tags", headers={"X-User-ID": "mallory"})
+    assert r.status == 403
+    core.unblock_user("mallory")
+    r = await client.post("/api/generate", json={
+        "model": "test-tiny", "prompt": "x", "stream": False,
+        "options": {"num_predict": 1},
+    }, headers={"X-User-ID": "mallory"})
+    assert r.status == 200
+
+
+@api_test
+async def test_user_id_header_default_anonymous(client):
+    await client.post("/api/generate", json={
+        "model": "test-tiny", "prompt": "x", "stream": False,
+        "options": {"num_predict": 1},
+    })
+    r = await client.get("/metrics")
+    stats = await r.json()
+    assert "anonymous" in stats["queue"]["users"]
+
+
+@api_test
+async def test_user_id_header_tracked(client):
+    await client.post("/api/generate", json={
+        "model": "test-tiny", "prompt": "x", "stream": False,
+        "options": {"num_predict": 1},
+    }, headers={"X-User-ID": "alice"})
+    r = await client.get("/metrics")
+    stats = await r.json()
+    assert stats["queue"]["users"]["alice"]["processed"] == 1
