@@ -1,0 +1,143 @@
+"""Benchmark: decode throughput (tok/s/chip) + prefill TTFT through the
+real engine runtime on whatever accelerator jax.devices() provides.
+
+Workload = BASELINE.json config 4's shape: a full decode batch of
+concurrent sequences sharing every step (the reference's ceiling is one
+request per backend; the TPU engine's is `--slots` per chip). Prints ONE
+JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+vs_baseline is against the 2000 tok/s/chip north-star target
+(BASELINE.md — the reference itself publishes no numbers).
+
+Usage: python bench.py [--model llama3.2:1b] [--slots 64] [--steps 256]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="llama3.2:1b")
+    p.add_argument("--slots", type=int, default=64)
+    p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--steps", type=int, default=256, help="decode steps to time")
+    p.add_argument("--chunk", type=int, default=16, help="decode steps per dispatch")
+    p.add_argument("--warmup-steps", type=int, default=32)
+    p.add_argument("--ttft-samples", type=int, default=8)
+    args = p.parse_args()
+
+    import jax
+
+    import numpy as np
+
+    from ollamamq_tpu.config import MODEL_CONFIGS, EngineConfig
+    from ollamamq_tpu.engine.engine import ModelRuntime
+    from ollamamq_tpu.engine.request import Request
+    from ollamamq_tpu.core import MQCore
+    from ollamamq_tpu.ops.sampling import SamplingParams
+
+    from ollamamq_tpu.config import get_model_config
+
+    model_cfg = get_model_config(args.model)
+    if model_cfg is None:
+        print(json.dumps({"error": f"unknown model '{args.model}'",
+                          "known": sorted(MODEL_CONFIGS)}))
+        return 2
+    dev = jax.devices()[0]
+    # Pages: prompt + generated headroom for every slot.
+    tokens_per_seq = args.prompt_len + args.steps + args.chunk
+    page_size = 16
+    pages_per_seq = -(-tokens_per_seq // page_size) + 1
+    ecfg = EngineConfig(
+        model=args.model,
+        max_slots=args.slots,
+        num_pages=args.slots * pages_per_seq + 2,
+        page_size=page_size,
+        max_pages_per_seq=pages_per_seq,
+        prefill_buckets=(args.prompt_len,),
+        max_new_tokens=10**9,
+        decode_steps_per_iter=args.chunk,
+    )
+    core = MQCore(None)
+    t0 = time.monotonic()
+    rt = ModelRuntime(args.model, model_cfg, ecfg)
+    init_s = time.monotonic() - t0
+
+    rng = np.random.default_rng(0)
+
+    def make_req(i):
+        prompt = rng.integers(3, min(model_cfg.vocab_size, 30000),
+                              size=args.prompt_len).tolist()
+        req = Request(i + 1, f"user{i}", args.model, prompt,
+                      SamplingParams(max_tokens=10**9))
+        req._inc_decode = rt.tokenizer.make_incremental_decoder()
+        return req
+
+    # TTFT: sequential prefills on the otherwise-empty engine (compile first).
+    ttfts = []
+    for i in range(args.ttft_samples):
+        rt.pending_prefill.append(make_req(1000 + i))
+        t0 = time.monotonic()
+        rt.step_prefill(core)
+        ttfts.append((time.monotonic() - t0) * 1e3)
+        # Clear the slot again so the throughput phase starts clean.
+        for s, r in enumerate(rt.slot_req):
+            if r is not None:
+                from ollamamq_tpu.engine.request import FinishReason
+                rt._finish_slot(s, FinishReason.CANCELLED, core)
+    ttft_compile_ms = ttfts[0]
+    ttft_p50_ms = statistics.median(ttfts[1:]) if len(ttfts) > 1 else ttfts[0]
+
+    # Fill every slot.
+    rt.tokenizer.eos_id = -1  # keep sequences alive for the whole bench
+    for i in range(args.slots):
+        rt.pending_prefill.append(make_req(i))
+        rt.step_prefill(core)
+    active = rt.active_count()
+
+    # Warmup (compiles the decode chunk).
+    rt.step_decode(core, k_steps=args.chunk)
+    warm_remaining = max(0, args.warmup_steps - args.chunk)
+    while warm_remaining > 0:
+        rt.step_decode(core, k_steps=args.chunk)
+        warm_remaining -= args.chunk
+
+    # Timed run.
+    done_steps = 0
+    t0 = time.monotonic()
+    while done_steps < args.steps:
+        emitted = rt.step_decode(core, k_steps=args.chunk)
+        if emitted == 0:
+            break
+        done_steps += args.chunk
+    elapsed = time.monotonic() - t0
+    tokens = active * done_steps
+    tok_per_s = tokens / elapsed if elapsed > 0 else 0.0
+
+    result = {
+        "metric": "decode_tok_per_s_per_chip",
+        "value": round(tok_per_s, 1),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tok_per_s / 2000.0, 3),
+        "model": args.model,
+        "device": str(dev),
+        "slots": active,
+        "prompt_len": args.prompt_len,
+        "decode_steps": done_steps,
+        "chunk": args.chunk,
+        "step_ms": round(elapsed / max(1, done_steps) * 1e3, 3),
+        "ttft_p50_ms": round(ttft_p50_ms, 1),
+        "ttft_compile_ms": round(ttft_compile_ms, 1),
+        "init_s": round(init_s, 1),
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -571,15 +571,19 @@ class TPUEngine:
         loop may observe the queued id before registration; _admit tolerates
         that by parking the id as an orphan, but only enqueue_request is
         race-free."""
-        if req.req_id in self._expired_orphans:
-            # Its queue slot was already dropped after the orphan grace
-            # period; registering it now would leak it in `pending`.
-            del self._expired_orphans[req.req_id]
+        with self._pending_lock:
+            if req.req_id in self._expired_orphans:
+                # Its queue slot was already dropped after the orphan grace
+                # period; registering it now would leak it in `pending`.
+                del self._expired_orphans[req.req_id]
+                expired = True
+            else:
+                self.pending[req.req_id] = req
+                expired = False
+        if expired:
             req.finish(FinishReason.ERROR,
                        error="request expired before registration")
             return
-        with self._pending_lock:
-            self.pending[req.req_id] = req
         self.notify()
 
     def cancel(self, req_id: int) -> None:
@@ -650,25 +654,38 @@ class TPUEngine:
     def _admit(self) -> int:
         admitted = 0
         # Retry orphans: ids popped before their Request was registered
-        # (two-step submit flow); give them a 5 s grace. Placement respects
-        # runtime capacity — an orphan whose runtime is full stays parked.
+        # (two-step submit flow); give them a 5 s grace. Expiry always runs;
+        # the capacity gate only defers placement of registered requests.
         now = time.monotonic()
         for rid, user, model, ts in list(self._orphans):
-            rt = self.resolve_runtime(model)
-            if rt is not None and not rt.has_capacity():
-                continue
             with self._pending_lock:
                 req = self.pending.pop(rid, None)
-            if req is not None:
-                self._orphans.remove((rid, user, model, ts))
-                if self._place(req, user, model):
-                    admitted += 1
-            elif now - ts > 5.0:
-                self._orphans.remove((rid, user, model, ts))
+                if req is None and now - ts > 5.0:
+                    # Expire under the lock so submit() can't slip the
+                    # Request into `pending` between our check and write.
+                    self._orphans.remove((rid, user, model, ts))
+                    self._expired_orphans[rid] = now
+                    req_expired = True
+                else:
+                    req_expired = False
+            if req_expired:
                 self.core.mark_dropped(user, started=False)
-                # If the Request shows up via submit() later, fail it
-                # immediately instead of leaking it in `pending` forever.
-                self._expired_orphans[rid] = now
+                continue
+            if req is None:
+                continue  # still within grace, not yet registered
+            rt = self.resolve_runtime(model)
+            if rt is not None and not rt.has_capacity():
+                # Runtime full: put the Request back and retry later.
+                with self._pending_lock:
+                    self.pending[rid] = req
+                continue
+            self._orphans.remove((rid, user, model, ts))
+            if self._place(req, user, model):
+                admitted += 1
+        # Age out expiry tombstones nothing ever claimed (slow leak guard).
+        for rid, ts in list(self._expired_orphans.items()):
+            if now - ts > 60.0:
+                del self._expired_orphans[rid]
         while True:
             eligible = [
                 name for name, rt in self.runtimes.items() if rt.has_capacity()

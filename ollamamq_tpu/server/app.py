@@ -112,9 +112,12 @@ class Server:
             raw = await request.read()
             if not raw:
                 return {}
-            return json.loads(raw)
+            body = json.loads(raw)
         except json.JSONDecodeError:
             raise ApiError(400, "invalid JSON body")
+        if not isinstance(body, dict):
+            raise ApiError(400, "request body must be a JSON object")
+        return body
 
     def _resolve_model(self, name: str):
         if not name:
@@ -244,13 +247,12 @@ class Server:
         user, ip = self._ident(request)
         body = await self._body_json(request)
         model = body.get("model", "")
-        self._resolve_model(model)
+        entry = self._resolve_model(model)
         messages = body.get("messages", [])
         stream = body.get("stream", True)
         sampling = SamplingParams.from_ollama_options(
             body.get("options"), self.engine.ecfg.max_new_tokens
         )
-        entry = self.registry.resolve(model)
         prompt = render_chat(messages, entry.config if entry else get_model_config(model))
         tokens = self._tokenize(model, prompt)
         req = self._enqueue(user, ip, model, Family.OLLAMA, tokens, sampling,
@@ -395,7 +397,10 @@ class Server:
             await loop.run_in_executor(None, self.registry.pull, name)
 
         if not stream:
-            await do_pull()
+            try:
+                await do_pull()
+            except Exception as e:
+                raise ApiError(500, f"failed to load {name}: {e}")
             return web.json_response({"status": "success"})
         resp = web.StreamResponse()
         resp.content_type = "application/x-ndjson"
@@ -403,7 +408,14 @@ class Server:
         await resp.write((json.dumps({"status": "pulling manifest"}) + "\n").encode())
         await resp.write((json.dumps(
             {"status": f"loading {name} into HBM"}) + "\n").encode())
-        await do_pull()
+        try:
+            await do_pull()
+        except Exception as e:
+            # The 200 status is already on the wire; signal failure in-band
+            # the way Ollama does (an "error" line instead of "success").
+            await resp.write((json.dumps({"error": f"failed to load {name}: {e}"}) + "\n").encode())
+            await resp.write_eof()
+            return resp
         await resp.write((json.dumps({"status": "success"}) + "\n").encode())
         await resp.write_eof()
         return resp
@@ -456,11 +468,10 @@ class Server:
         user, ip = self._ident(request)
         body = await self._body_json(request)
         model = body.get("model", "")
-        self._resolve_model(model)
+        entry = self._resolve_model(model)
         messages = body.get("messages", [])
         stream = body.get("stream", False)
         sampling = SamplingParams.from_openai(body, self.engine.ecfg.max_new_tokens)
-        entry = self.registry.resolve(model)
         prompt = render_chat(messages, entry.config if entry else get_model_config(model))
         tokens = self._tokenize(model, prompt)
         req = self._enqueue(user, ip, model, Family.OPENAI, tokens, sampling,
@@ -477,18 +488,42 @@ class Server:
         model = body.get("model", "")
         self._resolve_model(model)
         prompt = body.get("prompt", "")
-        if isinstance(prompt, list):
-            prompt = prompt[0] if prompt else ""
+        prompts = prompt if isinstance(prompt, list) else [prompt]
+        if not prompts:
+            prompts = [""]
         stream = body.get("stream", False)
         sampling = SamplingParams.from_openai(body, self.engine.ecfg.max_new_tokens)
-        tokens = self._tokenize(model, prompt)
-        req = self._enqueue(user, ip, model, Family.OPENAI, tokens, sampling,
-                            raw_prompt=prompt)
         rid = f"cmpl-{uuid.uuid4().hex[:24]}"
         if stream:
+            if len(prompts) > 1:
+                raise ApiError(400, "streaming with multiple prompts is not supported")
+            tokens = self._tokenize(model, prompts[0])
+            req = self._enqueue(user, ip, model, Family.OPENAI, tokens, sampling,
+                                raw_prompt=prompts[0])
             return await self._openai_stream(request, model, req, rid, chat=False)
-        items = await self._collect(req)
-        return self._openai_final(model, req, items, rid, chat=False)
+        # One choice per prompt (OpenAI list-prompt semantics).
+        reqs = [
+            self._enqueue(user, ip, model, Family.OPENAI,
+                          self._tokenize(model, p), sampling, raw_prompt=p)
+            for p in prompts
+        ]
+        choices, usage_p, usage_c = [], 0, 0
+        for i, req in enumerate(reqs):
+            items = await self._collect(req)
+            err = next((it for it in items if it.kind == "error"), None)
+            if err is not None:
+                raise ApiError(500, f"engine error: {err.error}")
+            text = "".join(it.text for it in items if it.kind == "token")
+            choices.append({"index": i, "text": text,
+                            "finish_reason": self._done_reason(items[-1])})
+            usage_p += req.stats.prompt_tokens
+            usage_c += req.stats.completion_tokens
+        return web.json_response({
+            "id": rid, "object": "text_completion", "created": int(time.time()),
+            "model": model, "choices": choices,
+            "usage": {"prompt_tokens": usage_p, "completion_tokens": usage_c,
+                      "total_tokens": usage_p + usage_c},
+        })
 
     def _openai_final(self, model, req, items, rid, chat: bool):
         err = next((i for i in items if i.kind == "error"), None)

@@ -11,6 +11,7 @@ evicts — BASELINE.json config 5's load/evict semantics.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import threading
 import time
 from typing import Dict, List, Optional
@@ -113,7 +114,7 @@ class ModelRegistry:
                 "model": e.name,
                 "modified_at": _iso(e.registered_at),
                 "size": e.config.param_count() * 2,  # bf16 bytes
-                "digest": f"tpu-native-{abs(hash(e.name)) % 10**12:012d}",
+                "digest": _digest(e.name),
                 "details": self._details(e.config),
             })
         return {"models": models}
@@ -128,7 +129,7 @@ class ModelRegistry:
                 "model": e.name,
                 "size": size,
                 "size_vram": size,  # HBM-resident (TPU's "VRAM")
-                "digest": f"tpu-native-{abs(hash(e.name)) % 10**12:012d}",
+                "digest": _digest(e.name),
                 "expires_at": _iso(time.time() + 3600),
                 "details": self._details(e.config),
             })
@@ -179,6 +180,10 @@ class ModelRegistry:
             "parameter_size": size_label,
             "quantization_level": "BF16",
         }
+
+
+def _digest(name: str) -> str:
+    return "sha256:" + hashlib.sha256(name.encode()).hexdigest()[:24]
 
 
 def _iso(ts: float) -> str:

@@ -1,0 +1,14 @@
+"""The driver contract: multi-chip dry run must compile+run on the
+virtual CPU mesh (entry() uses the 1b model and is compile-checked by
+the driver itself, not here)."""
+
+import jax
+import pytest
+
+
+def test_dryrun_multichip_8():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)
