@@ -1,0 +1,192 @@
+/* Minimal recursive-descent JSON parser for the TUI's two data feeds
+ * (the core snapshot from mq_snapshot_json and the engine-stats callback).
+ * Not a general-purpose library: enough JSON for our own wire shapes. */
+#ifndef MINIJSON_H
+#define MINIJSON_H
+
+#include <cctype>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace mj {
+
+struct Value;
+using ValuePtr = std::shared_ptr<Value>;
+
+struct Value {
+  enum Type { NUL, BOOL, NUM, STR, ARR, OBJ } type = NUL;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::vector<ValuePtr> arr;
+  std::map<std::string, ValuePtr> obj;
+
+  bool is_null() const { return type == NUL; }
+  double as_num(double d = 0) const { return type == NUM ? num : d; }
+  long long as_int(long long d = 0) const {
+    return type == NUM ? (long long)num : d;
+  }
+  const std::string &as_str(const std::string &d = "") const {
+    static const std::string empty;
+    return type == STR ? str : (d.empty() ? empty : d);
+  }
+  ValuePtr get(const std::string &k) const {
+    auto it = obj.find(k);
+    return it == obj.end() ? nullptr : it->second;
+  }
+};
+
+class Parser {
+ public:
+  explicit Parser(const std::string &s) : s_(s) {}
+
+  ValuePtr parse() {
+    skip();
+    return value();
+  }
+
+ private:
+  const std::string &s_;
+  size_t i_ = 0;
+
+  void skip() {
+    while (i_ < s_.size() && std::isspace((unsigned char)s_[i_])) ++i_;
+  }
+  char peek() { return i_ < s_.size() ? s_[i_] : '\0'; }
+  char next() { return i_ < s_.size() ? s_[i_++] : '\0'; }
+
+  ValuePtr value() {
+    skip();
+    char c = peek();
+    if (c == '{') return object();
+    if (c == '[') return array();
+    if (c == '"') return string_v();
+    if (c == 't' || c == 'f') return boolean();
+    if (c == 'n') {
+      i_ += 4;
+      return std::make_shared<Value>();
+    }
+    return number();
+  }
+
+  ValuePtr object() {
+    auto v = std::make_shared<Value>();
+    v->type = Value::OBJ;
+    next();  // {
+    skip();
+    if (peek() == '}') {
+      next();
+      return v;
+    }
+    while (i_ < s_.size()) {
+      skip();
+      auto key = string_v();
+      skip();
+      next();  // :
+      v->obj[key->str] = value();
+      skip();
+      if (peek() == ',') {
+        next();
+        continue;
+      }
+      next();  // }
+      break;
+    }
+    return v;
+  }
+
+  ValuePtr array() {
+    auto v = std::make_shared<Value>();
+    v->type = Value::ARR;
+    next();  // [
+    skip();
+    if (peek() == ']') {
+      next();
+      return v;
+    }
+    while (i_ < s_.size()) {
+      v->arr.push_back(value());
+      skip();
+      if (peek() == ',') {
+        next();
+        continue;
+      }
+      next();  // ]
+      break;
+    }
+    return v;
+  }
+
+  ValuePtr string_v() {
+    auto v = std::make_shared<Value>();
+    v->type = Value::STR;
+    next();  // "
+    while (i_ < s_.size()) {
+      char c = next();
+      if (c == '"') break;
+      if (c == '\\' && i_ < s_.size()) {
+        char e = next();
+        switch (e) {
+          case 'n': v->str += '\n'; break;
+          case 't': v->str += '\t'; break;
+          case 'r': v->str += '\r'; break;
+          case 'u': {
+            // Keep it simple: skip the 4 hex digits, emit '?' for
+            // non-ASCII escapes (TUI-safe).
+            unsigned code = 0;
+            for (int k = 0; k < 4 && i_ < s_.size(); ++k)
+              code = code * 16 + (std::isdigit((unsigned char)s_[i_])
+                                      ? s_[i_] - '0'
+                                      : (std::tolower((unsigned char)s_[i_]) - 'a' + 10)),
+              ++i_;
+            if (code < 0x80) v->str += (char)code;
+            else v->str += '?';
+            break;
+          }
+          default: v->str += e;
+        }
+      } else {
+        v->str += c;
+      }
+    }
+    return v;
+  }
+
+  ValuePtr boolean() {
+    auto v = std::make_shared<Value>();
+    v->type = Value::BOOL;
+    if (peek() == 't') {
+      v->b = true;
+      i_ += 4;
+    } else {
+      v->b = false;
+      i_ += 5;
+    }
+    return v;
+  }
+
+  ValuePtr number() {
+    auto v = std::make_shared<Value>();
+    v->type = Value::NUM;
+    size_t start = i_;
+    while (i_ < s_.size() &&
+           (std::isdigit((unsigned char)s_[i_]) || s_[i_] == '-' ||
+            s_[i_] == '+' || s_[i_] == '.' || s_[i_] == 'e' || s_[i_] == 'E'))
+      ++i_;
+    v->num = std::stod(s_.substr(start, i_ - start));
+    return v;
+  }
+};
+
+inline ValuePtr parse(const std::string &s) {
+  try {
+    return Parser(s).parse();
+  } catch (...) {
+    return std::make_shared<Value>();
+  }
+}
+
+}  // namespace mj
+#endif

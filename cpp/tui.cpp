@@ -1,0 +1,550 @@
+/* Native admin TUI — ANSI/termios, no curses dependency.
+ *
+ * Re-creates the reference dashboard's semantics (tui.rs) on top of the
+ * TPU engine: the backends panel becomes a CHIPS/MODELS panel showing HBM
+ * occupancy, decode step latency, and tok/s per model runtime instead of
+ * Ollama URL status. Key map preserved from the reference
+ * (tui.rs:102-303):
+ *
+ *   q/Esc quit (whole app)     ?        toggle help
+ *   Tab/h/l  cycle panel       j/k      move selection
+ *   Space/Enter expand model detail
+ *   p  VIP toggle on selected user (clears boost only if the SAME user
+ *      held it — tui.rs:169-175)
+ *   b  boost toggle (symmetric — tui.rs:196-202)
+ *   x  block selected user     X  block selected user's IP
+ *   u  unblock selected blocked item
+ *
+ * Data feeds: the mqcore snapshot (same-process, via mq_snapshot_json)
+ * and an engine-stats callback provided by the embedding Python process
+ * (model runtimes, HBM, step latency). Rendering double-buffers into a
+ * string and writes one frame per refresh to avoid flicker; input is
+ * select(2)-polled at the reference's 100 ms cadence (tui.rs:112).
+ */
+
+#include <sys/ioctl.h>
+#include <sys/select.h>
+#include <termios.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <string>
+#include <vector>
+
+#include "minijson.h"
+#include "mqcore.h"
+
+extern "C" {
+typedef long long (*mq_stats_cb)(char *buf, long long cap);
+int mqtui_run(mq_state *state, mq_stats_cb stats_cb, int refresh_ms);
+}
+
+namespace {
+
+struct TermGuard {
+  termios orig{};
+  bool ok = false;
+  TermGuard() {
+    if (tcgetattr(STDIN_FILENO, &orig) == 0) {
+      termios raw = orig;
+      raw.c_lflag &= ~(ICANON | ECHO);
+      raw.c_cc[VMIN] = 0;
+      raw.c_cc[VTIME] = 0;
+      tcsetattr(STDIN_FILENO, TCSANOW, &raw);
+      ok = true;
+    }
+    // Alt screen + hide cursor.
+    (void)!write(STDOUT_FILENO, "\x1b[?1049h\x1b[?25l", 14);
+  }
+  ~TermGuard() {
+    (void)!write(STDOUT_FILENO, "\x1b[?1049l\x1b[?25h", 14);
+    if (ok) tcsetattr(STDIN_FILENO, TCSANOW, &orig);
+  }
+};
+
+struct UserRow {
+  std::string name;
+  long long queued = 0, processing = 0, processed = 0, dropped = 0, tokens = 0;
+  std::string ip;
+};
+
+// Colors.
+const char *RST = "\x1b[0m";
+const char *BOLD = "\x1b[1m";
+const char *DIM = "\x1b[2m";
+const char *CYAN = "\x1b[36m";
+const char *GREEN = "\x1b[32m";
+const char *YELLOW = "\x1b[33m";
+const char *RED = "\x1b[31m";
+const char *MAGENTA = "\x1b[35m";
+const char *INV = "\x1b[7m";
+
+std::string pad(const std::string &s, size_t w) {
+  // Width-naive truncate/pad (ASCII data; user ids clipped hard).
+  if (s.size() >= w) return s.substr(0, w);
+  return s + std::string(w - s.size(), ' ');
+}
+
+std::string human_bytes(double b) {
+  char buf[32];
+  if (b >= 1e9) std::snprintf(buf, sizeof buf, "%.1fG", b / 1e9);
+  else if (b >= 1e6) std::snprintf(buf, sizeof buf, "%.0fM", b / 1e6);
+  else if (b >= 1e3) std::snprintf(buf, sizeof buf, "%.0fK", b / 1e3);
+  else std::snprintf(buf, sizeof buf, "%.0fB", b);
+  return buf;
+}
+
+struct Tui {
+  mq_state *state;
+  mq_stats_cb stats_cb;
+  int panel = 0;  // 0 chips/models, 1 users, 2 queues, 3 blocked
+  int sel[4] = {0, 0, 0, 0};
+  bool expanded = false;
+  bool help = false;
+  // tok/s rate from successive tokens_generated samples.
+  double last_tokens = -1;
+  double tok_rate = 0;
+  timespec last_sample{};
+
+  std::string frame;
+
+  void put(const std::string &s) { frame += s; }
+  void line(const std::string &s, int width) {
+    frame += pad_visible(s, width);
+    frame += "\x1b[K\r\n";
+  }
+
+  // pad to visible width ignoring escape sequences
+  static std::string pad_visible(const std::string &s, int width) {
+    int vis = 0;
+    std::string out;
+    for (size_t i = 0; i < s.size();) {
+      if (s[i] == '\x1b') {
+        size_t j = i + 1;
+        while (j < s.size() && s[j] != 'm') ++j;
+        out += s.substr(i, j - i + 1);
+        i = j + 1;
+      } else {
+        if (vis < width) {
+          out += s[i];
+          ++vis;
+        }
+        ++i;
+      }
+    }
+    while (vis < width) {
+      out += ' ';
+      ++vis;
+    }
+    return out;
+  }
+
+  mj::ValuePtr snapshot() {
+    long long need = mq_snapshot_json(state, nullptr, 0);
+    std::string buf(need + 16, '\0');
+    mq_snapshot_json(state, buf.data(), (long long)buf.size());
+    buf.resize(std::strlen(buf.c_str()));
+    return mj::parse(buf);
+  }
+
+  bool quit_requested = false;
+
+  mj::ValuePtr engine_stats() {
+    if (!stats_cb) return std::make_shared<mj::Value>();
+    std::string buf(65536, '\0');
+    long long n = stats_cb(buf.data(), (long long)buf.size());
+    if (n == -9) {  // embedder requests shutdown (e.g. Ctrl-C in Python)
+      quit_requested = true;
+      return std::make_shared<mj::Value>();
+    }
+    // Bounds-check hard: a failed ctypes callback can return garbage.
+    if (n <= 0 || n >= (long long)buf.size())
+      return std::make_shared<mj::Value>();
+    buf.resize((size_t)n);
+    return mj::parse(buf);
+  }
+
+  std::vector<UserRow> user_rows(const mj::ValuePtr &snap) {
+    std::vector<UserRow> rows;
+    auto users = snap->get("users");
+    if (!users) return rows;
+    for (auto &kv : users->obj) {
+      UserRow r;
+      r.name = kv.first;
+      auto &u = kv.second;
+      r.queued = u->get("queued") ? u->get("queued")->as_int() : 0;
+      r.processing = u->get("processing") ? u->get("processing")->as_int() : 0;
+      r.processed = u->get("processed") ? u->get("processed")->as_int() : 0;
+      r.dropped = u->get("dropped") ? u->get("dropped")->as_int() : 0;
+      r.tokens = u->get("tokens") ? u->get("tokens")->as_int() : 0;
+      if (u->get("ip")) r.ip = u->get("ip")->as_str();
+      rows.push_back(std::move(r));
+    }
+    // Reference ordering (tui.rs:76-85): active first (queued+processing
+    // desc), then lifetime (processed+dropped desc), then name.
+    std::sort(rows.begin(), rows.end(), [](const UserRow &a, const UserRow &b) {
+      long long aa = a.queued + a.processing, bb = b.queued + b.processing;
+      if (aa != bb) return aa > bb;
+      long long al = a.processed + a.dropped, bl = b.processed + b.dropped;
+      if (al != bl) return al > bl;
+      return a.name < b.name;
+    });
+    return rows;
+  }
+
+  void render(int rows, int cols) {
+    frame.clear();
+    put("\x1b[H");  // home
+
+    auto snap = snapshot();
+    auto stats = engine_stats();
+    auto users = user_rows(snap);
+    std::string vip = snap->get("vip") && !snap->get("vip")->is_null()
+                          ? snap->get("vip")->as_str() : "";
+    std::string boost = snap->get("boost") && !snap->get("boost")->is_null()
+                            ? snap->get("boost")->as_str() : "";
+
+    // ---- stats bar ----
+    long long tq = 0, tp = 0, tdone = 0, tdrop = 0, ttok = 0;
+    for (auto &u : users) {
+      tq += u.queued; tp += u.processing; tdone += u.processed;
+      tdrop += u.dropped; ttok += u.tokens;
+    }
+    // tok/s from engine counter deltas.
+    double tokens_now = 0;
+    auto models = stats->get("models");
+    if (models)
+      for (auto &m : models->arr)
+        tokens_now += m->get("tokens_generated")
+                          ? m->get("tokens_generated")->as_num() : 0;
+    timespec now{};
+    clock_gettime(CLOCK_MONOTONIC, &now);
+    if (last_tokens >= 0) {
+      double dt = (now.tv_sec - last_sample.tv_sec) +
+                  (now.tv_nsec - last_sample.tv_nsec) / 1e9;
+      if (dt > 0.5) {
+        tok_rate = 0.7 * tok_rate + 0.3 * ((tokens_now - last_tokens) / dt);
+        last_tokens = tokens_now;
+        last_sample = now;
+      }
+    } else {
+      last_tokens = tokens_now;
+      last_sample = now;
+    }
+
+    char bar[512];
+    std::snprintf(bar, sizeof bar,
+                  " ollamaMQ-TPU   queued %lld   processing %lld   served %lld   "
+                  "dropped %lld   tok/s %.0f",
+                  tq, tp, tdone, tdrop, tok_rate > 0 ? tok_rate : 0.0);
+    put(std::string(BOLD) + INV);
+    line(bar, cols);
+    put(RST);
+
+    if (help) {
+      render_help(rows, cols);
+      return;
+    }
+
+    // ---- three columns: chips/models | users | queues ----
+    int col1 = cols * 35 / 100, col2 = cols * 35 / 100;
+    int col3 = cols - col1 - col2 - 2;
+    int body = rows - 2 /*bars*/ - 6 /*blocked + headers*/;
+    if (body < 4) body = 4;
+
+    std::vector<std::string> c1 = render_models(stats, col1, body);
+    std::vector<std::string> c2 = render_users(users, vip, boost, col2, body);
+    std::vector<std::string> c3 = render_queues(users, tq, col3, body);
+    for (int i = 0; i < body; ++i) {
+      std::string l;
+      l += pad_visible(i < (int)c1.size() ? c1[i] : "", col1);
+      l += "\x1b[2m|\x1b[0m";
+      l += pad_visible(i < (int)c2.size() ? c2[i] : "", col2);
+      l += "\x1b[2m|\x1b[0m";
+      l += pad_visible(i < (int)c3.size() ? c3[i] : "", col3);
+      line(l, cols);
+    }
+
+    // ---- blocked items ----
+    put(std::string(BOLD));
+    line(panel == 3 ? "> BLOCKED ITEMS" : "  BLOCKED ITEMS", cols);
+    put(RST);
+    std::vector<std::string> blocked;
+    if (snap->get("blocked_users"))
+      for (auto &b : snap->get("blocked_users")->arr)
+        blocked.push_back("user " + b->as_str());
+    if (snap->get("blocked_ips"))
+      for (auto &b : snap->get("blocked_ips")->arr)
+        blocked.push_back("ip   " + b->as_str());
+    if (sel[3] >= (int)blocked.size()) sel[3] = blocked.empty() ? 0 : blocked.size() - 1;
+    for (int i = 0; i < 3; ++i) {
+      if (i < (int)blocked.size()) {
+        std::string marker = (panel == 3 && i == sel[3]) ? "> " : "  ";
+        line(marker + std::string(RED) + "✖ " + RST + blocked[i], cols);
+      } else {
+        line(i == 0 && blocked.empty() ? std::string(DIM) + "  (none)" + RST : "", cols);
+      }
+    }
+
+    // ---- help bar ----
+    put(DIM);
+    line(" q quit  ? help  Tab panel  j/k move  p VIP  b boost  x block  X block-ip  u unblock  Space expand",
+         cols);
+    put(RST);
+  }
+
+  std::vector<std::string> render_models(const mj::ValuePtr &stats, int w, int body) {
+    std::vector<std::string> out;
+    std::string hdr = panel == 0 ? "> CHIPS / MODELS" : "  CHIPS / MODELS";
+    out.push_back(std::string(BOLD) + hdr + RST);
+    double hbm_used = stats->get("hbm_used") ? stats->get("hbm_used")->as_num() : 0;
+    double hbm_total = stats->get("hbm_total") ? stats->get("hbm_total")->as_num() : 0;
+    std::string dev = stats->get("device") ? stats->get("device")->as_str() : "?";
+    char l[256];
+    if (hbm_total > 0)
+      std::snprintf(l, sizeof l, " %s  HBM %s/%s (%.0f%%)", dev.c_str(),
+                    human_bytes(hbm_used).c_str(), human_bytes(hbm_total).c_str(),
+                    100.0 * hbm_used / hbm_total);
+    else
+      std::snprintf(l, sizeof l, " %s  HBM %s", dev.c_str(),
+                    human_bytes(hbm_used).c_str());
+    out.push_back(std::string(CYAN) + l + RST);
+    auto models = stats->get("models");
+    if (!models) return out;
+    int idx = 0;
+    if (sel[0] >= (int)models->arr.size())
+      sel[0] = models->arr.empty() ? 0 : models->arr.size() - 1;
+    for (auto &m : models->arr) {
+      std::string name = m->get("model") ? m->get("model")->as_str() : "?";
+      long long act = m->get("active_slots") ? m->get("active_slots")->as_int() : 0;
+      long long slots = m->get("max_slots") ? m->get("max_slots")->as_int() : 0;
+      double step = m->get("step_latency_ms") ? m->get("step_latency_ms")->as_num() : 0;
+      std::string marker = (panel == 0 && idx == sel[0]) ? "> " : "  ";
+      const char *color = act > 0 ? GREEN : DIM;
+      std::snprintf(l, sizeof l, "%s%s  %lld/%lld slots  %.1fms/step",
+                    marker.c_str(), name.c_str(), act, slots, step);
+      out.push_back(std::string(color) + l + RST);
+      if (expanded && panel == 0 && idx == sel[0]) {
+        long long pu = m->get("pages_used") ? m->get("pages_used")->as_int() : 0;
+        long long pt = m->get("pages_total") ? m->get("pages_total")->as_int() : 0;
+        double pb = m->get("param_bytes") ? m->get("param_bytes")->as_num() : 0;
+        double kb = m->get("kv_bytes") ? m->get("kv_bytes")->as_num() : 0;
+        long long pend = m->get("pending_prefill")
+                             ? m->get("pending_prefill")->as_int() : 0;
+        std::snprintf(l, sizeof l, "    KV pages %lld/%lld  prefillQ %lld", pu, pt, pend);
+        out.push_back(std::string(DIM) + l + RST);
+        std::snprintf(l, sizeof l, "    params %s  kv-pool %s",
+                      human_bytes(pb).c_str(), human_bytes(kb).c_str());
+        out.push_back(std::string(DIM) + l + RST);
+        double pfms = m->get("prefill_latency_ms")
+                          ? m->get("prefill_latency_ms")->as_num() : 0;
+        std::snprintf(l, sizeof l, "    last prefill %.1fms (TTFT path)", pfms);
+        out.push_back(std::string(DIM) + l + RST);
+      }
+      ++idx;
+      if ((int)out.size() >= body) break;
+    }
+    return out;
+  }
+
+  std::vector<std::string> render_users(const std::vector<UserRow> &users,
+                                        const std::string &vip,
+                                        const std::string &boost,
+                                        int w, int body) {
+    std::vector<std::string> out;
+    std::string hdr = panel == 1 ? "> USERS" : "  USERS";
+    out.push_back(std::string(BOLD) + hdr + RST);
+    if (sel[1] >= (int)users.size()) sel[1] = users.empty() ? 0 : users.size() - 1;
+    int idx = 0;
+    for (auto &u : users) {
+      std::string sym, color = DIM;
+      if (u.name == vip) { sym += "★"; color = YELLOW; }
+      if (u.name == boost) { sym += "⚡"; color = MAGENTA; }
+      if (mq_is_user_blocked(state, u.name.c_str())) { sym += "✖"; color = RED; }
+      if (u.processing > 0) { sym += "▶"; if (color == DIM) color = GREEN; }
+      else if (u.queued > 0) { sym += "●"; if (color == DIM) color = CYAN; }
+      std::string marker = (panel == 1 && idx == sel[1]) ? "> " : "  ";
+      char l[256];
+      std::snprintf(l, sizeof l, "%s%s %s  q%lld r%lld d%lld x%lld t%lld",
+                    marker.c_str(), pad(u.name, 14).c_str(), pad(sym, 3).c_str(),
+                    u.queued, u.processing, u.processed, u.dropped, u.tokens);
+      out.push_back(color + l + RST);
+      ++idx;
+      if ((int)out.size() >= body) break;
+    }
+    if (users.empty())
+      out.push_back(std::string(DIM) + "  (no users yet)" + RST);
+    return out;
+  }
+
+  std::vector<std::string> render_queues(const std::vector<UserRow> &users,
+                                         long long total_queued, int w, int body) {
+    std::vector<std::string> out;
+    std::string hdr = panel == 2 ? "> QUEUES" : "  QUEUES";
+    out.push_back(std::string(BOLD) + hdr + RST);
+    int barw = w - 22;
+    if (barw < 5) barw = 5;
+    int idx = 0;
+    for (auto &u : users) {
+      if (u.queued == 0 && idx >= 3) continue;
+      // Reference scaling: 20 queued requests = full bar (tui.rs:529-547).
+      int fill = (int)std::min<long long>(u.queued * barw / 20, barw);
+      double pct = total_queued > 0 ? 100.0 * u.queued / total_queued : 0;
+      char l[256];
+      std::string bar = std::string(fill, '#') + std::string(barw - fill, ' ');
+      std::snprintf(l, sizeof l, "  %s [%s] %3.0f%%",
+                    pad(u.name, 10).c_str(), bar.c_str(), pct);
+      out.push_back((u.queued > 0 ? std::string(CYAN) : std::string(DIM)) + l + RST);
+      ++idx;
+      if ((int)out.size() >= body) break;
+    }
+    return out;
+  }
+
+  void render_help(int rows, int cols) {
+    const char *lines[] = {
+      "",
+      "  KEYS",
+      "    q / Esc      quit (stops the whole server)",
+      "    ?            toggle this help",
+      "    Tab / h / l  cycle focused panel",
+      "    j / k        move selection in the focused panel",
+      "    Space/Enter  expand model details (chips panel)",
+      "    p            toggle VIP on the selected user (absolute priority)",
+      "    b            toggle Boost on the selected user (wins every 2nd tick)",
+      "    x            block the selected user   (persists to blocked_items.json)",
+      "    X            block the selected user's IP",
+      "    u            unblock the selected blocked item",
+      "",
+      "  PANELS",
+      "    CHIPS/MODELS  model runtimes on the TPU: slots, step latency, HBM",
+      "    USERS         fair-share state: ★VIP ⚡boost ✖blocked ▶processing ●queued",
+      "    QUEUES        per-user queue depth (full bar = 20 requests)",
+      "    BLOCKED       persisted user/IP blocklist",
+      "",
+      "  press ? to return",
+    };
+    for (auto *l : lines) line(l, cols);
+    for (int i = 0; i < rows - 2 - (int)(sizeof(lines) / sizeof(*lines)); ++i)
+      line("", cols);
+  }
+
+  // ---- actions ----
+  void act_on_key(char c, const std::vector<UserRow> &users,
+                  const std::vector<std::string> &blocked_items,
+                  const std::string &vip, const std::string &boost) {
+    switch (c) {
+      case '\t': case 'l': panel = (panel + 1) % 4; break;
+      case 'h': panel = (panel + 3) % 4; break;
+      case 'j': sel[panel] += 1; break;
+      case 'k': if (sel[panel] > 0) sel[panel] -= 1; break;
+      case ' ': case '\r': expanded = !expanded; break;
+      case '?': help = !help; break;
+      case 'p': {
+        if (panel == 1 && sel[1] < (int)users.size()) {
+          const std::string &u = users[sel[1]].name;
+          if (vip == u) {
+            mq_set_vip(state, nullptr);
+          } else {
+            mq_set_vip(state, u.c_str());
+            if (boost == u) mq_set_boost(state, nullptr);  // tui.rs:169-175
+          }
+        }
+        break;
+      }
+      case 'b': {
+        if (panel == 1 && sel[1] < (int)users.size()) {
+          const std::string &u = users[sel[1]].name;
+          if (boost == u) {
+            mq_set_boost(state, nullptr);
+          } else {
+            mq_set_boost(state, u.c_str());
+            if (vip == u) mq_set_vip(state, nullptr);  // tui.rs:196-202
+          }
+        }
+        break;
+      }
+      case 'x': {
+        if (panel == 1 && sel[1] < (int)users.size())
+          mq_block_user(state, users[sel[1]].name.c_str());
+        break;
+      }
+      case 'X': {
+        if (panel == 1 && sel[1] < (int)users.size() &&
+            !users[sel[1]].ip.empty())
+          mq_block_ip(state, users[sel[1]].ip.c_str());
+        break;
+      }
+      case 'u': {
+        if (panel == 3 && sel[3] < (int)blocked_items.size())
+          mq_unblock_item(state, blocked_items[sel[3]].c_str());
+        break;
+      }
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" int mqtui_run(mq_state *state, mq_stats_cb stats_cb, int refresh_ms) {
+  if (!isatty(STDIN_FILENO) || !isatty(STDOUT_FILENO)) return 1;
+  TermGuard guard;
+  Tui tui;
+  tui.state = state;
+  tui.stats_cb = stats_cb;
+  if (refresh_ms <= 0) refresh_ms = 100;
+
+  while (true) {
+    winsize ws{};
+    ioctl(STDOUT_FILENO, TIOCGWINSZ, &ws);
+    int rows = ws.ws_row > 0 ? ws.ws_row : 24;
+    int cols = ws.ws_col > 0 ? ws.ws_col : 80;
+    tui.render(rows, cols);
+    if (tui.quit_requested) return 0;
+    (void)!write(STDOUT_FILENO, tui.frame.data(), tui.frame.size());
+
+    fd_set rfds;
+    FD_ZERO(&rfds);
+    FD_SET(STDIN_FILENO, &rfds);
+    timeval tv{refresh_ms / 1000, (refresh_ms % 1000) * 1000};
+    int r = select(STDIN_FILENO + 1, &rfds, nullptr, nullptr, &tv);
+    if (r > 0) {
+      char c = 0;
+      if (read(STDIN_FILENO, &c, 1) == 1) {
+        if (c == 'q' || c == '\x1b') {
+          // Check for a bare Esc (not an escape sequence).
+          if (c == '\x1b') {
+            char seq[2];
+            timeval zero{0, 0};
+            fd_set f2;
+            FD_ZERO(&f2);
+            FD_SET(STDIN_FILENO, &f2);
+            if (select(STDIN_FILENO + 1, &f2, nullptr, nullptr, &zero) > 0) {
+              (void)!read(STDIN_FILENO, seq, 2);  // swallow arrow keys etc.
+              continue;
+            }
+          }
+          return 0;  // quit => caller stops the whole app (main.rs:174-177)
+        }
+        // Need fresh data for the action context.
+        auto snap = tui.snapshot();
+        auto users = tui.user_rows(snap);
+        std::vector<std::string> blocked;
+        if (snap->get("blocked_users"))
+          for (auto &b : snap->get("blocked_users")->arr)
+            blocked.push_back(b->as_str());
+        if (snap->get("blocked_ips"))
+          for (auto &b : snap->get("blocked_ips")->arr)
+            blocked.push_back(b->as_str());
+        std::string vip = snap->get("vip") && !snap->get("vip")->is_null()
+                              ? snap->get("vip")->as_str() : "";
+        std::string boost = snap->get("boost") && !snap->get("boost")->is_null()
+                                ? snap->get("boost")->as_str() : "";
+        tui.act_on_key(c, users, blocked, vip, boost);
+      }
+    }
+  }
+}

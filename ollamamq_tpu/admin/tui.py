@@ -1,16 +1,110 @@
-"""Admin TUI (placeholder — full curses dashboard lands with the admin
-milestone). `run_tui` blocks until quit, mirroring the reference's
-tui_loop on the main thread (main.rs:162-188)."""
+"""Admin TUI front: runs the native C++ dashboard (cpp/tui.cpp) on the
+calling thread, feeding it engine stats through a callback.
+
+Mirrors the reference lifecycle (main.rs:134-150): the HTTP server runs on
+background threads, the TUI owns the terminal, and quitting the TUI ends
+the whole process. All admin actions (VIP/boost/block/unblock) mutate the
+shared native core directly — the scheduler sees them on its next pop.
+"""
 
 from __future__ import annotations
 
-import time
+import ctypes
+import json
+import logging
+
+from ollamamq_tpu.core.mqcore import _get_lib
+
+log = logging.getLogger("ollamamq.tui")
+
+# POINTER(c_char), NOT c_char_p: c_char_p would hand the callback an
+# immutable bytes copy and memmove would scribble on interpreter memory.
+_STATS_CB = ctypes.CFUNCTYPE(
+    ctypes.c_longlong, ctypes.POINTER(ctypes.c_char), ctypes.c_longlong
+)
 
 
-def run_tui(engine, registry) -> None:
-    print("TUI not yet implemented; running headless. Ctrl-C to exit.")
+_hbm_cache = {"ts": 0.0, "used": 0, "total": 0, "device": ""}
+
+
+def _engine_stats_brief(engine) -> dict:
+    """Compact stats JSON for the chips panel.
+
+    Called at the 10 Hz TUI cadence, so it must stay cheap: per-runtime
+    stats only (no core.snapshot — the native TUI reads the queue state
+    itself), with device/HBM numbers cached for 2 s (a memory_stats call
+    can be a tunnel round-trip on remote TPU setups).
+    """
+    import time
+
+    models = [rt.stats() for rt in list(engine.runtimes.values())]
+    now = time.monotonic()
+    if now - _hbm_cache["ts"] > 2.0:
+        used = sum(m["param_bytes"] + m["kv_bytes"] for m in models)
+        total = 0
+        device = ""
+        try:
+            import jax
+
+            dev = jax.local_devices()[0]
+            device = str(dev)
+            ms = dev.memory_stats()
+            if ms:
+                used = ms.get("bytes_in_use", used)
+                total = ms.get("bytes_limit") or 0
+        except Exception:
+            pass
+        _hbm_cache.update(ts=now, used=used, total=total, device=device)
+    return {
+        "models": models,
+        "device": _hbm_cache["device"] or "no-device",
+        "hbm_used": _hbm_cache["used"],
+        "hbm_total": _hbm_cache["total"],
+    }
+
+
+def run_tui(engine, registry, refresh_ms: int = 100) -> None:
+    """Blocks until the operator quits (q/Esc). Returns then — the caller
+    shuts the server down (TUI exit == process exit, like the reference)."""
+    import signal
+
+    lib = _get_lib()
+    lib.mqtui_run.restype = ctypes.c_int
+    lib.mqtui_run.argtypes = [ctypes.c_void_p, _STATS_CB, ctypes.c_int]
+
+    # Ctrl-C must not raise inside the ctypes callback (an interrupt at
+    # callback entry is uncatchable there and corrupts the return value);
+    # instead a flag-setting handler turns it into a clean quit request.
+    interrupted = {"flag": False}
+
+    def _on_sigint(signum, frame):
+        interrupted["flag"] = True
+
+    prev_handler = signal.signal(signal.SIGINT, _on_sigint)
+
+    def cb(buf, cap):
+        if interrupted["flag"]:
+            return -9  # tell the C loop to exit cleanly
+        try:
+            data = json.dumps(_engine_stats_brief(engine)).encode()
+        except BaseException:
+            return 0
+        if len(data) >= cap:
+            return 0
+        ctypes.memmove(buf, data, len(data))
+        return len(data)
+
+    cb_ref = _STATS_CB(cb)  # keep alive for the whole run
     try:
-        while True:
-            time.sleep(1)
-    except KeyboardInterrupt:
-        pass
+        rc = lib.mqtui_run(engine.core._h, cb_ref, refresh_ms)
+    finally:
+        signal.signal(signal.SIGINT, prev_handler)
+    if rc != 0:
+        log.warning("TUI unavailable (not a TTY); running headless")
+        import time
+
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass

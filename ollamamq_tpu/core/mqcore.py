@@ -35,10 +35,15 @@ class Fairness(enum.IntEnum):
 
 def _ensure_built() -> str:
     with _BUILD_LOCK:
-        src = os.path.join(_CPP_DIR, "mqcore.cpp")
-        if not os.path.exists(_LIB_PATH) or (
-            os.path.exists(src) and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
-        ):
+        sources = [
+            os.path.join(_CPP_DIR, f)
+            for f in os.listdir(_CPP_DIR)
+            if f.endswith((".cpp", ".h"))
+        ]
+        stale = not os.path.exists(_LIB_PATH) or any(
+            os.path.getmtime(s) > os.path.getmtime(_LIB_PATH) for s in sources
+        )
+        if stale:
             subprocess.run(
                 ["make", "-C", _CPP_DIR], check=True, capture_output=True, text=True
             )
