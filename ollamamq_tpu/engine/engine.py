@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import collections
 import logging
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -102,6 +103,16 @@ class ModelRuntime:
         self._prefill_jits: Dict[int, callable] = {}
         self._decode_jits: Dict[int, callable] = {}
         self._rng_counter = engine_cfg.seed
+        # Ragged paged-attention Pallas kernel on TPU; jnp gather fallback
+        # elsewhere (and under OLLAMAMQ_NO_PALLAS=1 for A/B benching).
+        no_pallas = os.environ.get("OLLAMAMQ_NO_PALLAS", "").lower() not in (
+            "", "0", "false", "no",
+        )
+        self.attn_impl = (
+            "pallas"
+            if jax.default_backend() == "tpu" and not no_pallas
+            else "jnp"
+        )
 
         # Telemetry.
         self.step_latency_ms = 0.0
@@ -165,12 +176,14 @@ class ModelRuntime:
     def _get_decode_jit(self, k_steps: int):
         if k_steps not in self._decode_jits:
             cfg, ps = self.cfg, self.ecfg.page_size
+            attn_impl = self.attn_impl
 
             def fn(params, tokens, positions, kc, vc, pt, temp, tk, tp, key):
                 def step(carry, _):
                     tokens, positions, kc, vc, key = carry
                     logits, kc, vc = llama.forward_decode(
-                        params, cfg, tokens, positions, kc, vc, pt, ps
+                        params, cfg, tokens, positions, kc, vc, pt, ps,
+                        attn_impl=attn_impl,
                     )
                     key, sub = jax.random.split(key)
                     nxt = sample_tokens(logits, sub, temp, tk, tp)

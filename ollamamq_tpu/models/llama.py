@@ -100,6 +100,28 @@ def _logits(params: dict, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     return jnp.einsum("btd,vd->btv", x.astype(jnp.float32), head.astype(jnp.float32))
 
 
+def _layer_step(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
+                positions: jnp.ndarray, attn_fn):
+    """One transformer layer over a full [B, T, D] sequence.
+
+    The SINGLE definition of the layer math for every full-sequence
+    forward (prefill, sequence-parallel prefill, encoder) — only the
+    attention schedule differs, injected as `attn_fn(q, k, v) -> [B,T,H,hd]`.
+    Returns (x', k, v) so callers can scatter K/V into the paged cache.
+    (forward_decode keeps its own body: it must write K/V into the scan-
+    carried cache BEFORE attending.)
+    """
+    B, T, _ = x.shape
+    h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    q, k, v = _qkv(cfg, lp, h)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+    attn = attn_fn(q, k, v)
+    x = x + jnp.einsum("bte,ed->btd", attn.reshape(B, T, cfg.q_dim), lp["wo"])
+    h2 = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+    return x + _mlp(lp, h2), k, v
+
+
 def forward_prefill(
     params: dict,
     cfg: ModelConfig,
@@ -123,16 +145,12 @@ def forward_prefill(
     def body(carry, per_layer):
         x = carry
         lp, kc, vc = per_layer
-        h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(cfg, lp, h)
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
+        x, k, v = _layer_step(
+            cfg, lp, x, positions,
+            lambda q, k, v: causal_attention(q, k, v, seq_lens),
+        )
         kc = kc.at[slots].set(k)
         vc = vc.at[slots].set(v)
-        attn = causal_attention(q, k, v, seq_lens)
-        x = x + jnp.einsum("bte,ed->btd", attn.reshape(B, T, cfg.q_dim), lp["wo"])
-        h2 = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(lp, h2)
         return x, (kc, vc)
 
     x, (k_cache, v_cache) = jax.lax.scan(
@@ -153,6 +171,7 @@ def forward_decode(
     v_cache: jnp.ndarray,
     page_table: jnp.ndarray,  # [B, max_pages]
     page_size: int,
+    attn_impl: str = "jnp",  # "jnp" reference | "pallas" ragged TPU kernel
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One decode step for the whole batch; returns (logits [B,V], caches')."""
     B = tokens.shape[0]
@@ -170,9 +189,18 @@ def forward_decode(
         k = apply_rope(k, pos2, cfg.rope_theta)
         kc = kc.at[write_slots].set(k[:, 0])
         vc = vc.at[write_slots].set(v[:, 0])
-        attn = paged_decode_attention(
-            q[:, 0], kc, vc, page_table, seq_lens, page_size
-        )  # [B,H,hd]
+        if attn_impl == "pallas":
+            from ollamamq_tpu.ops.pallas.paged_attention import (
+                paged_decode_attention_pallas,
+            )
+
+            attn = paged_decode_attention_pallas(
+                q[:, 0], kc, vc, page_table, seq_lens, page_size
+            )
+        else:
+            attn = paged_decode_attention(
+                q[:, 0], kc, vc, page_table, seq_lens, page_size
+            )  # [B,H,hd]
         x = x + jnp.einsum("be,ed->bd", attn.reshape(B, cfg.q_dim), lp["wo"])[:, None, :]
         h2 = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + _mlp(lp, h2)
@@ -183,6 +211,47 @@ def forward_decode(
     )
     logits = _logits(params, cfg, x)[:, 0, :]
     return logits, k_cache, v_cache
+
+
+def forward_prefill_sp(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, T] — T sharded over the mesh "seq" axis
+    seq_lens: jnp.ndarray,  # [B]
+    mesh,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sequence-parallel prefill for long contexts: activations sharded
+    along T over the "seq" mesh axis, attention via ring attention
+    (K/V blocks rotate over ICI). Returns (last_logits [B,V],
+    k_stack [L,B,T,Hk,hd], v_stack) — the caller scatters K/V into the
+    paged pool. Numerics match forward_prefill exactly (same f32 online
+    softmax), only the schedule is distributed.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as PS
+
+    from ollamamq_tpu.parallel.mesh import AXIS_SEQ
+    from ollamamq_tpu.parallel.ring_attention import ring_attention
+
+    B, T = tokens.shape
+    seq_sharded = NamedSharding(mesh, PS(None, AXIS_SEQ, None))
+    x = params["embed"][tokens].astype(params["embed"].dtype)
+    x = jax.lax.with_sharding_constraint(x, seq_sharded)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+
+    def body(carry, lp):
+        x = carry
+        x, k, v = _layer_step(
+            cfg, lp, x, positions,
+            lambda q, k, v: ring_attention(q, k, v, seq_lens, mesh),
+        )
+        x = jax.lax.with_sharding_constraint(x, seq_sharded)
+        return x, (k, v)
+
+    x, (k_stack, v_stack) = jax.lax.scan(body, x, params["layers"])
+    last = jnp.clip(seq_lens - 1, 0, T - 1)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)
+    logits = _logits(params, cfg, x_last)[:, 0, :]
+    return logits, k_stack, v_stack
 
 
 def forward_encoder(
@@ -198,14 +267,10 @@ def forward_encoder(
 
     def body(carry, lp):
         x = carry
-        h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(cfg, lp, h)
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
-        attn = bidirectional_attention(q, k, v, seq_lens)
-        x = x + jnp.einsum("bte,ed->btd", attn.reshape(B, T, cfg.q_dim), lp["wo"])
-        h2 = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(lp, h2)
+        x, _, _ = _layer_step(
+            cfg, lp, x, positions,
+            lambda q, k, v: bidirectional_attention(q, k, v, seq_lens),
+        )
         return x, None
 
     x, _ = jax.lax.scan(body, x, params["layers"])

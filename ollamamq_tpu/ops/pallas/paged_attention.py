@@ -1,0 +1,192 @@
+"""Pallas TPU kernel: ragged paged decode attention.
+
+The jnp reference path (ops/attention.py:paged_decode_attention) gathers a
+padded [B, max_pages*page_size, Hk, hd] context per step — materializing
+the whole window in HBM traffic even for short sequences. This kernel
+instead walks each sequence's ACTUAL pages: per batch element, double-
+buffered DMA streams K/V pages HBM→VMEM while the previous page's partial
+attention accumulates with an online (flash-style) softmax, so HBM reads
+scale with true context length (ragged), not the padded maximum.
+
+Layout contract (matches engine/kv_cache.py):
+    k_cache, v_cache: [S, Hk, hd] flat slot pool; a page is `page_size`
+    contiguous slots starting at page_id * page_size.
+    page_table: [B, max_pages] int32 (trash page 0 padding)
+    seq_lens:   [B] int32 — context length INCLUDING the current token
+
+Grid: one program per batch element; page_table/seq_lens ride scalar
+prefetch so the DMA offsets are known before the body runs
+(PrefetchScalarGridSpec pattern from the Pallas TPU guide).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    page_table_ref,  # [B, max_pages] SMEM
+    seq_lens_ref,  # [B] SMEM
+    # inputs
+    q_ref,  # [1, H, hd] VMEM (this program's query)
+    k_hbm,  # [S, Hk, hd] HBM
+    v_hbm,  # [S, Hk, hd] HBM
+    # output
+    o_ref,  # [1, H, hd] VMEM
+    # scratch
+    k_buf,  # [2, page_size, Hk, hd] VMEM
+    v_buf,  # [2, page_size, Hk, hd] VMEM
+    acc,  # [H, hd] f32 VMEM
+    m_i,  # [H, 1] f32 VMEM running max
+    l_i,  # [H, 1] f32 VMEM running denom
+    sems,  # [2, 2] DMA semaphores (buffer, k/v)
+    *,
+    page_size: int,
+    max_pages: int,
+    num_heads: int,
+    num_kv_heads: int,
+    head_dim: int,
+):
+    b = pl.program_id(0)
+    seq_len = seq_lens_ref[b]
+    # Clamp to the table width: a seq_len beyond capacity must not index
+    # page_table out of bounds (the jnp reference implicitly truncates the
+    # context the same way).
+    num_pages = jnp.minimum(pl.cdiv(seq_len, page_size), max_pages)
+
+    def page_dma(slot, page_idx):
+        page_id = page_table_ref[b, page_idx]
+        start = page_id * page_size
+        k_dma = pltpu.make_async_copy(
+            k_hbm.at[pl.ds(start, page_size)], k_buf.at[slot], sems.at[slot, 0]
+        )
+        v_dma = pltpu.make_async_copy(
+            v_hbm.at[pl.ds(start, page_size)], v_buf.at[slot], sems.at[slot, 1]
+        )
+        return k_dma, v_dma
+
+    # Warm up: first page in flight.
+    k0, v0 = page_dma(0, 0)
+    k0.start()
+    v0.start()
+
+    acc[...] = jnp.zeros_like(acc)
+    m_i[...] = jnp.full_like(m_i, NEG_INF)
+    l_i[...] = jnp.zeros_like(l_i)
+
+    q = q_ref[0].astype(jnp.float32)  # [H, hd]
+    scale = 1.0 / (head_dim ** 0.5)
+    group = num_heads // num_kv_heads
+
+    def body(p, _):
+        slot = p % 2
+        nxt = (p + 1) % 2
+
+        @pl.when(p + 1 < num_pages)
+        def _():
+            kn, vn = page_dma(nxt, p + 1)
+            kn.start()
+            vn.start()
+
+        kp, vp = page_dma(slot, p)
+        kp.wait()
+        vp.wait()
+
+        k = k_buf[slot].astype(jnp.float32)  # [ps, Hk, hd]
+        v = v_buf[slot].astype(jnp.float32)
+        # GQA: broadcast kv heads over query-head groups.
+        # scores[h, t] = q[h] . k[t, h // group]
+        qr = q.reshape(num_kv_heads, group, head_dim)
+        s = jax.lax.dot_general(
+            qr, k,
+            dimension_numbers=(((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )  # [Hk, group, ps]
+        s = s.reshape(num_heads, page_size) * scale
+
+        # Mask positions beyond the sequence (final partial page).
+        pos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (num_heads, page_size), 1
+        )
+        s = jnp.where(pos < seq_len, s, NEG_INF)
+
+        # Online softmax update.
+        m_prev = m_i[...]  # [H, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p_ij = jnp.exp(s - m_new)  # [H, ps]
+        l_i[...] = l_i[...] * alpha + jnp.sum(p_ij, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p_ij.reshape(num_kv_heads, group, page_size), v,
+            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )  # [Hk, group, hd]
+        acc[...] = acc[...] * alpha + pv.reshape(num_heads, head_dim)
+        m_i[...] = m_new
+        return ()
+
+    jax.lax.fori_loop(0, num_pages, body, ())
+
+    denom = jnp.maximum(l_i[...], 1e-20)
+    o_ref[0] = (acc[...] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
+def paged_decode_attention_pallas(
+    q: jnp.ndarray,  # [B, H, hd]
+    k_cache: jnp.ndarray,  # [S, Hk, hd]
+    v_cache: jnp.ndarray,  # [S, Hk, hd]
+    page_table: jnp.ndarray,  # [B, max_pages]
+    seq_lens: jnp.ndarray,  # [B]
+    page_size: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    B, H, hd = q.shape
+    _, Hk, _ = k_cache.shape
+    max_pages = page_table.shape[1]
+
+    kernel = functools.partial(
+        _decode_kernel,
+        page_size=page_size,
+        max_pages=max_pages,
+        num_heads=H,
+        num_kv_heads=Hk,
+        head_dim=hd,
+    )
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, H, hd), lambda b, *_: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),  # k stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),  # v stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, H, hd), lambda b, *_: (b, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, page_size, Hk, hd), k_cache.dtype),
+            pltpu.VMEM((2, page_size, Hk, hd), v_cache.dtype),
+            pltpu.VMEM((H, hd), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, hd), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      q, k_cache, v_cache)

@@ -1,0 +1,103 @@
+"""Ring attention: causal attention with the sequence sharded over the
+"seq" mesh axis — the framework's long-context / context-parallel prefill.
+
+The reference has no long-context story at all (sequence length was
+Ollama's problem — SURVEY.md §5); here it is first-class: a prompt longer
+than one chip's HBM/FLOPs budget is split into contiguous chunks across
+the "seq" axis, each device computes blockwise attention for its local
+queries while K/V blocks rotate around the ring via `lax.ppermute` —
+XLA lowers the rotation to ICI neighbor transfers, overlapping them with
+the local block's compute. Online (flash-style) softmax accumulation keeps
+the math exact vs. full attention.
+
+Causality over the ring: at rotation step s, a device holding query chunk
+`i` sees the K/V chunk originally at `(i - s) mod sp`:
+  - earlier chunk  -> full attention
+  - same chunk     -> causal mask within the block
+  - later chunk    -> contributes nothing (masked out entirely)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ollamamq_tpu.ops.attention import repeat_kv
+from ollamamq_tpu.parallel.mesh import AXIS_SEQ
+
+NEG_INF = -1e30
+
+
+def _ring_attention_local(q, k, v, seq_lens, *, axis: str):
+    """Per-device body under shard_map.
+
+    q, k, v: [B, C, H(k), hd] — this device's chunk (C = T / sp)
+    seq_lens: [B] global valid lengths (replicated)
+    """
+    idx = jax.lax.axis_index(axis)
+    sp = jax.lax.axis_size(axis)
+    B, C, Hk, hd = k.shape
+    H = q.shape[2]
+    n_rep = H // Hk
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+
+    qf = q.astype(jnp.float32)
+    q_pos = idx * C + jnp.arange(C)  # [C] global positions of local queries
+
+    acc = jnp.zeros((B, H, C, hd), jnp.float32)
+    m_i = jnp.full((B, H, C, 1), NEG_INF, jnp.float32)
+    l_i = jnp.zeros((B, H, C, 1), jnp.float32)
+
+    def step(s, carry):
+        acc, m_i, l_i, k_cur, v_cur = carry
+        k_idx = (idx - s) % sp  # which chunk k_cur originally was
+        k_pos = k_idx * C + jnp.arange(C)  # [C] global key positions
+
+        kk = repeat_kv(k_cur, n_rep).astype(jnp.float32)
+        vv = repeat_kv(v_cur, n_rep).astype(jnp.float32)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kk) * scale  # [B,H,C,C]
+        mask = (k_pos[None, :] <= q_pos[:, None])  # causal across chunks
+        mask = mask[None, None] & (k_pos[None, None, None, :] < seq_lens[:, None, None, None])
+        logits = jnp.where(mask, logits, NEG_INF)
+
+        m_new = jnp.maximum(m_i, jnp.max(logits, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_i - m_new)
+        p_ij = jnp.exp(logits - m_new)
+        l_new = l_i * alpha + jnp.sum(p_ij, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum("bhqk,bkhd->bhqd", p_ij, vv)
+
+        # Rotate K/V around the ring: device d sends to d+1.
+        perm = [(d, (d + 1) % sp) for d in range(sp)]
+        k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+        return acc_new, m_new, l_new, k_nxt, v_nxt
+
+    acc, m_i, l_i, _, _ = jax.lax.fori_loop(
+        0, sp, step, (acc, m_i, l_i, k, v)
+    )
+    out = acc / jnp.maximum(l_i, 1e-20)  # [B,H,C,hd]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # [B,C,H,hd]
+
+
+def ring_attention(
+    q: jnp.ndarray,  # [B, T, H, hd] sharded on T over the "seq" axis
+    k: jnp.ndarray,  # [B, T, Hk, hd]
+    v: jnp.ndarray,
+    seq_lens: jnp.ndarray,  # [B] replicated
+    mesh: Mesh,
+    axis: str = AXIS_SEQ,
+) -> jnp.ndarray:
+    """Causal ring attention over the mesh's sequence axis. Exact (up to
+    f32 accumulation order) vs single-device causal attention."""
+    body = functools.partial(_ring_attention_local, axis=axis)
+    spec_qkv = P(None, axis, None, None)
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec_qkv, spec_qkv, spec_qkv, P()),
+        out_specs=spec_qkv,
+        check_vma=False,
+    )(q, k, v, seq_lens)
