@@ -1,0 +1,34 @@
+# ollamaMQ-TPU runtime image.
+#
+# Unlike the reference's musl-static two-stage build (~10 MB runtime), a
+# TPU serving image necessarily carries the JAX/XLA stack; the native
+# serving core (cpp/) is compiled in a separate build stage.
+#
+# Build:  docker build -t ollamamq-tpu .
+# Run:    see docker-compose.yml (TPU device access + env configuration)
+FROM python:3.12-slim AS build
+
+RUN apt-get update && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY cpp/ cpp/
+RUN make -C cpp
+
+FROM python:3.12-slim
+
+# jax[tpu] pulls libtpu; pinned loosely — the serving code tracks jax>=0.9.
+RUN pip install --no-cache-dir "jax[tpu]" aiohttp tokenizers safetensors \
+    orbax-checkpoint numpy \
+    -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+
+WORKDIR /app
+COPY ollamamq_tpu/ ollamamq_tpu/
+COPY cpp/*.h cpp/*.cpp cpp/Makefile cpp/
+COPY --from=build /app/cpp/libmqcore.so cpp/
+COPY scripts/ scripts/
+COPY docker-entrypoint.sh .
+RUN chmod +x docker-entrypoint.sh scripts/*.sh
+
+EXPOSE 11434
+ENTRYPOINT ["./docker-entrypoint.sh"]

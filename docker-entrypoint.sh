@@ -1,0 +1,20 @@
+#!/bin/sh
+# Env -> CLI flag translation (the reference pattern: BACKEND_URLS/PORT/
+# TIMEOUT envs feeding the binary; here MODELS replaces backend URLs).
+# Args accumulate via `set --` so values with spaces survive quoting.
+set -e
+
+set -- --no-tui --host 0.0.0.0
+[ -n "${MODELS:-}" ] && set -- "$@" --models "$MODELS"
+[ -n "${CHECKPOINTS:-}" ] && set -- "$@" --checkpoints "$CHECKPOINTS"
+[ -n "${PORT:-}" ] && set -- "$@" --port "$PORT"
+[ -n "${TIMEOUT:-}" ] && set -- "$@" --timeout "$TIMEOUT"
+[ -n "${TP:-}" ] && set -- "$@" --tp "$TP"
+[ -n "${DP:-}" ] && set -- "$@" --dp "$DP"
+[ -n "${MAX_SLOTS:-}" ] && set -- "$@" --max-slots "$MAX_SLOTS"
+[ -n "${BLOCKLIST:-}" ] && set -- "$@" --blocklist "$BLOCKLIST"
+[ "${ALLOW_ALL_ROUTES:-}" = "true" ] && set -- "$@" --allow-all-routes
+[ "${FAKE_ENGINE:-}" = "true" ] && set -- "$@" --fake-engine
+
+cd /app
+exec python -m ollamamq_tpu.cli "$@"

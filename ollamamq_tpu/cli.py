@@ -81,6 +81,13 @@ def main(argv=None) -> int:
     from ollamamq_tpu.config import EngineConfig
     from ollamamq_tpu.core import Fairness
 
+    # Multi-host control plane: no-op unless JAX_COORDINATOR_ADDRESS /
+    # JAX_NUM_PROCESSES are set (or a TPU pod auto-detects). After this,
+    # jax.devices() spans all hosts and tp=-1 shards over the whole pod.
+    from ollamamq_tpu.parallel import distributed
+
+    distributed.initialize()
+
     model_names = [m.strip() for m in args.models.split(",") if m.strip()]
     checkpoints = {}
     for pair in args.checkpoints.split(","):

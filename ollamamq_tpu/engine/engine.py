@@ -520,6 +520,7 @@ class TPUEngine:
         self._cond = threading.Condition()
         self._running = False
         self._thread: Optional[threading.Thread] = None
+        self.health = None
         self.started_at = time.time()
         models = models if models is not None else {engine_cfg.model: None}
         for name, ckpt in models.items():
@@ -656,6 +657,11 @@ class TPUEngine:
         self._running = True
         self._thread = threading.Thread(target=self._loop, name="engine", daemon=True)
         self._thread.start()
+        if self.health is None:
+            from ollamamq_tpu.engine.health import HealthMonitor
+
+            self.health = HealthMonitor(self)
+            self.health.start()
 
     def stop(self) -> None:
         self._running = False
@@ -663,6 +669,9 @@ class TPUEngine:
         if self._thread:
             self._thread.join(timeout=10)
             self._thread = None
+        if self.health is not None:
+            self.health.stop()
+            self.health = None
 
     def _admit(self) -> int:
         admitted = 0
@@ -809,5 +818,6 @@ class TPUEngine:
             "hbm_total_bytes": hbm_total,
             "devices": [str(d) for d in jax.devices()],
             "uptime_s": round(time.time() - self.started_at, 1),
+            "health": health.status() if (health := self.health) else None,
             "queue": self.core.snapshot(),
         }

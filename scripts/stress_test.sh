@@ -1,0 +1,88 @@
+#!/usr/bin/env bash
+# Live-server randomized stress: 50 users x 1-12 requests over 4 endpoints
+# and 2 models, 10% early-cancel, 5% multimodal payload. Behavioral port of
+# the reference's stress profile (watch the TUI while it runs).
+#
+# Usage: ./scripts/stress_test.sh [host:port] [model1] [model2]
+set -u
+
+TARGET="${1:-localhost:11434}"
+MODEL_A="${2:-llama3:8b}"
+MODEL_B="${3:-qwen2.5:7b}"
+PIDS=()
+
+# 1x1 transparent PNG for the multimodal 5%.
+IMG="iVBORw0KGgoAAAANSUhEUgAAAAEAAAABCAYAAAAfFcSJAAAADUlEQVR42mP8z8BQDwAEhQGAhKmMIQAAAABJRU5ErkJggg=="
+
+preflight() {
+  if ! curl -fsS "http://${TARGET}/health" >/dev/null; then
+    echo "server at ${TARGET} is not healthy; aborting" >&2
+    exit 1
+  fi
+}
+
+send_one() {
+  local user="$1" endpoint="$2" model="$3" n="$4" img="$5"
+  local body
+  case "$endpoint" in
+    /api/generate)
+      if [[ "$img" == yes ]]; then
+        body="{\"model\":\"$model\",\"prompt\":\"describe this\",\"images\":[\"$IMG\"],\"stream\":false,\"options\":{\"num_predict\":$n}}"
+      else
+        body="{\"model\":\"$model\",\"prompt\":\"stress $user\",\"stream\":false,\"options\":{\"num_predict\":$n}}"
+      fi ;;
+    /api/chat)
+      body="{\"model\":\"$model\",\"stream\":true,\"messages\":[{\"role\":\"user\",\"content\":\"hi from $user\"}],\"options\":{\"num_predict\":$n}}" ;;
+    /v1/chat/completions)
+      body="{\"model\":\"$model\",\"max_tokens\":$n,\"messages\":[{\"role\":\"user\",\"content\":\"hi from $user\"}]}" ;;
+    /v1/completions)
+      body="{\"model\":\"$model\",\"prompt\":\"stress $user\",\"max_tokens\":$n}" ;;
+  esac
+  out=$(curl -sS -X POST "http://${TARGET}${endpoint}" \
+        -H "Content-Type: application/json" -H "X-User-ID: ${user}" \
+        -d "$body" 2>/dev/null)
+  if [[ -n "$out" ]]; then echo "ok   ${user} ${endpoint} ${model}"; else echo "EMPTY ${user} ${endpoint}"; fi
+}
+
+send_and_cancel() {
+  local user="$1" endpoint="$2" model="$3"
+  curl -sS -X POST "http://${TARGET}${endpoint}" \
+       -H "Content-Type: application/json" -H "X-User-ID: ${user}" \
+       -d "{\"model\":\"$model\",\"prompt\":\"to be cancelled\",\"stream\":true,\"options\":{\"num_predict\":512}}" \
+       >/dev/null 2>&1 &
+  local cpid=$!
+  sleep 0.3
+  kill "$cpid" 2>/dev/null
+  echo "cxl  ${user} ${endpoint}"
+}
+
+preflight
+echo "stressing ${TARGET} with 50 users (models: ${MODEL_A}, ${MODEL_B})"
+
+for i in $(seq -w 0 49); do
+  user="user${i}"
+  reqs=$((RANDOM % 12 + 1))
+  for _ in $(seq 1 "$reqs"); do
+    case $((RANDOM % 4)) in
+      0) ep=/api/generate ;;
+      1) ep=/api/chat ;;
+      2) ep=/v1/chat/completions ;;
+      3) ep=/v1/completions ;;
+    esac
+    if (( RANDOM % 2 )); then model="$MODEL_A"; else model="$MODEL_B"; fi
+    n=$((RANDOM % 6 + 1))
+    r=$((RANDOM % 100))
+    if (( r < 10 )); then
+      send_and_cancel "$user" "$ep" "$model" &
+    elif (( r < 15 )) && [[ "$ep" == /api/generate ]]; then
+      send_one "$user" "$ep" "$model" "$n" yes &
+    else
+      send_one "$user" "$ep" "$model" "$n" no &
+    fi
+    PIDS+=($!)
+    sleep 0.0"$((RANDOM % 5))"
+  done
+done
+
+wait
+echo "done — check /metrics (or the TUI) for per-user accounting"

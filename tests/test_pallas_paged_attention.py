@@ -72,7 +72,7 @@ def test_model_decode_with_pallas_impl(tiny_cfg, tiny_params):
         )
         out_pal, _, _ = llama_mod.forward_decode(
             params, cfg, jnp.array([7], jnp.int32), jnp.array([5], jnp.int32),
-            kcj - 0 + (kc - kc), vc * 0 + vc, pt, PS_, attn_impl="pallas",
+            kc, vc, pt, PS_, attn_impl="pallas",
         )
     finally:
         pa.paged_decode_attention_pallas = orig

@@ -1,0 +1,176 @@
+"""Randomized multi-user integration stress — the port of the reference's
+only test (test_dispatcher.sh): 50 users x 1-12 randomized requests across
+4 endpoints x 2 models, 10% early-cancel, 5% multimodal (base64 image)
+payloads. Where the bash script's success criterion was "non-empty
+response body" + visual TUI inspection, this asserts the accounting
+invariants: every request either processed or dropped, queues drained,
+KV/slots reclaimed, and no engine stall.
+"""
+
+import asyncio
+import base64
+import json
+import random
+import tempfile
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from ollamamq_tpu.config import EngineConfig
+from ollamamq_tpu.engine.fake import FakeEngine
+from ollamamq_tpu.server.app import Server
+
+USERS = [f"user{i:02d}" for i in range(50)]
+MODELS = ["test-tiny", "qwen-fake"]  # Ollama-style + LM-Studio-style naming
+ENDPOINTS = ["/api/generate", "/api/chat", "/v1/chat/completions", "/v1/completions"]
+TINY_PNG = base64.b64encode(bytes.fromhex(
+    "89504e470d0a1a0a0000000d4948445200000001000000010802000000907753de"
+)).decode()
+
+
+def _body(endpoint: str, model: str, rng: random.Random) -> dict:
+    n = rng.randint(1, 6)
+    if endpoint == "/api/generate":
+        body = {"model": model, "prompt": "stress prompt", "stream": rng.random() < 0.5,
+                "options": {"num_predict": n}}
+        if rng.random() < 0.05:  # multimodal injection (5%)
+            body["images"] = [TINY_PNG]
+        return body
+    if endpoint == "/api/chat":
+        return {"model": model, "stream": rng.random() < 0.5,
+                "messages": [{"role": "user", "content": "hello"}],
+                "options": {"num_predict": n}}
+    if endpoint == "/v1/chat/completions":
+        return {"model": model, "stream": rng.random() < 0.5, "max_tokens": n,
+                "messages": [{"role": "user", "content": "hello"}]}
+    return {"model": model, "prompt": "stress", "max_tokens": n,
+            "stream": rng.random() < 0.3}
+
+
+def test_stress_50_users():
+    rng = random.Random(1234)
+
+    async def main():
+        with tempfile.TemporaryDirectory() as tmp:
+            eng = FakeEngine(
+                EngineConfig(model="test-tiny", max_slots=16),
+                models={"test-tiny": None, "qwen-fake": None},
+                blocklist_path=f"{tmp}/blocked_items.json",
+            )
+            # qwen-fake isn't a known architecture: register a FakeRuntime
+            # directly (the fake layer doesn't need a ModelConfig).
+            eng.start()
+            server = Server(eng, timeout_s=60)
+            # make the second model visible to the registry layer
+            server.registry._entries["qwen-fake"] = next(
+                iter(server.registry._entries.values())
+            ).__class__("qwen-fake", server.registry._entries["test-tiny"].config)
+            cl = TestClient(TestServer(server.build_app()))
+            await cl.start_server()
+            try:
+                stats = {"ok": 0, "cancelled": 0, "errors": 0}
+
+                async def one_request(user: str):
+                    endpoint = rng.choice(ENDPOINTS)
+                    model = rng.choice(MODELS)
+                    body = _body(endpoint, model, rng)
+                    cancel = rng.random() < 0.10  # 10% early-cancel
+                    try:
+                        if cancel:
+                            try:
+                                await asyncio.wait_for(
+                                    cl.post(endpoint, json=body,
+                                            headers={"X-User-ID": user}),
+                                    timeout=0.05,
+                                )
+                                stats["ok"] += 1
+                            except asyncio.TimeoutError:
+                                stats["cancelled"] += 1
+                            return
+                        r = await cl.post(endpoint, json=body,
+                                          headers={"X-User-ID": user})
+                        text = await r.text()
+                        assert r.status == 200, f"{endpoint}: {r.status} {text[:200]}"
+                        assert text.strip(), "empty response body"
+                        stats["ok"] += 1
+                    except AssertionError:
+                        raise
+                    except Exception:
+                        stats["errors"] += 1
+
+                tasks = []
+                for user in USERS:
+                    for _ in range(rng.randint(1, 12)):
+                        tasks.append(one_request(user))
+                rng.shuffle(tasks)
+                await asyncio.gather(*tasks)
+
+                # Drain: engine must settle with empty queues.
+                for _ in range(100):
+                    if eng.core.total_queued() == 0 and not any(
+                        rt.has_work() for rt in eng.runtimes.values()
+                    ):
+                        break
+                    await asyncio.sleep(0.05)
+                assert eng.core.total_queued() == 0
+
+                snap = eng.core.snapshot()
+                total_processed = sum(u["processed"] for u in snap["users"].values())
+                total_dropped = sum(u["dropped"] for u in snap["users"].values())
+                total_processing = sum(u["processing"] for u in snap["users"].values())
+                assert total_processing == 0  # gauge back to zero
+                assert stats["ok"] > 0 and stats["errors"] == 0
+                # Everything accounted for: completions + drops >= successful
+                # HTTP requests (cancelled ones may land either side).
+                assert total_processed + total_dropped >= stats["ok"]
+                # Fairness sanity: many distinct users actually got served.
+                served_users = [u for u, v in snap["users"].items() if v["processed"] > 0]
+                assert len(served_users) >= 40
+            finally:
+                await cl.close()
+                eng.stop()
+
+    asyncio.run(main())
+
+
+def test_stress_with_vip_boost_and_blocks():
+    """The 64-user VIP/Boost mix of BASELINE config 4 at the API level."""
+    rng = random.Random(99)
+
+    async def main():
+        with tempfile.TemporaryDirectory() as tmp:
+            eng = FakeEngine(
+                EngineConfig(model="test-tiny", max_slots=8),
+                models={"test-tiny": None},
+                blocklist_path=f"{tmp}/blocked_items.json",
+            )
+            eng.start()
+            eng.core.set_vip("vip-user")
+            eng.core.set_boost("boost-user")
+            eng.core.block_user("blocked-user")
+            server = Server(eng, timeout_s=60)
+            cl = TestClient(TestServer(server.build_app()))
+            await cl.start_server()
+            try:
+                users = [f"u{i}" for i in range(61)] + ["vip-user", "boost-user", "blocked-user"]
+
+                async def go(user):
+                    r = await cl.post("/api/generate", json={
+                        "model": "test-tiny", "prompt": "x", "stream": False,
+                        "options": {"num_predict": 2}},
+                        headers={"X-User-ID": user})
+                    return user, r.status
+
+                results = await asyncio.gather(*(go(u) for u in users))
+                by_user = dict(results)
+                assert by_user["blocked-user"] == 403
+                assert by_user["vip-user"] == 200
+                assert sum(1 for _, s in results if s == 200) == 63
+                snap = eng.core.snapshot()
+                assert snap["users"]["vip-user"]["processed"] == 1
+                assert "blocked-user" not in snap["users"] or \
+                    snap["users"]["blocked-user"]["processed"] == 0
+            finally:
+                await cl.close()
+                eng.stop()
+
+    asyncio.run(main())
