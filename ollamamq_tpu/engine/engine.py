@@ -90,6 +90,9 @@ class ModelRuntime:
         )
 
         S, MP = engine_cfg.max_slots, engine_cfg.max_pages_per_seq
+        # Slots mid-chunked-prefill: reserved (not schedulable) but not yet
+        # decoding — slot_req stays None so decode skips them.
+        self.reserved_slots: set = set()
         self.slot_req: List[Optional[Request]] = [None] * S
         self.slot_pages: List[List[int]] = [[] for _ in range(S)]
         self.page_table = np.full((S, MP), kvc.TRASH_PAGE, np.int32)
@@ -100,6 +103,8 @@ class ModelRuntime:
         self.top_p = np.ones((S,), np.float32)
 
         self.pending_prefill: collections.deque = collections.deque()
+        # Long prompts mid-chunked-prefill (one chunk advanced per tick).
+        self.chunking: collections.deque = collections.deque()
         self._prefill_jits: Dict[int, callable] = {}
         self._decode_jits: Dict[int, callable] = {}
         self._rng_counter = engine_cfg.seed
@@ -118,6 +123,8 @@ class ModelRuntime:
         self.step_latency_ms = 0.0
         self.prefill_latency_ms = 0.0
         self.tokens_generated = 0
+        self.ttft_window: collections.deque = collections.deque(maxlen=512)
+        self.step_window: collections.deque = collections.deque(maxlen=512)
         self.param_bytes = sum(
             x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(params)
         )
@@ -127,7 +134,10 @@ class ModelRuntime:
 
     # -- capacity ----------------------------------------------------------
     def free_slots(self) -> int:
-        return sum(r is None for r in self.slot_req)
+        return sum(
+            r is None and i not in self.reserved_slots
+            for i, r in enumerate(self.slot_req)
+        )
 
     def has_capacity(self) -> bool:
         """Can we take one more request from the scheduler right now?"""
@@ -138,7 +148,11 @@ class ModelRuntime:
         )
 
     def has_work(self) -> bool:
-        return bool(self.pending_prefill) or any(r is not None for r in self.slot_req)
+        return (
+            bool(self.pending_prefill)
+            or bool(self.chunking)
+            or any(r is not None for r in self.slot_req)
+        )
 
     def active_count(self) -> int:
         return sum(r is not None for r in self.slot_req)
@@ -172,6 +186,23 @@ class ModelRuntime:
 
             self._prefill_jits[bucket] = jax.jit(fn, donate_argnums=(3, 4))
         return self._prefill_jits[bucket]
+
+    def _get_chunk_jit(self, chunk: int):
+        """Chunked prefill step for prompts longer than the largest bucket:
+        each call writes one chunk's K/V and attends over the prefix. The
+        returned sampled token is only meaningful for the final chunk."""
+        if ("chunk", chunk) not in self._prefill_jits:
+            cfg, ps = self.cfg, self.ecfg.page_size
+
+            def fn(params, tokens, start, chunk_lens, kc, vc, pt, temp, tk, tp, key):
+                logits, kc, vc = llama.forward_prefill_chunk(
+                    params, cfg, tokens, start, chunk_lens, kc, vc, pt, ps
+                )
+                tok = sample_tokens(logits, key, temp, tk, tp)
+                return tok, kc, vc
+
+            self._prefill_jits[("chunk", chunk)] = jax.jit(fn, donate_argnums=(4, 5))
+        return self._prefill_jits[("chunk", chunk)]
 
     def _get_decode_jit(self, k_steps: int):
         if k_steps not in self._decode_jits:
@@ -239,6 +270,7 @@ class ModelRuntime:
         req.generated_ids.append(tok)
         if not req.stats.first_token_at:
             req.stats.first_token_at = time.monotonic()
+            self.ttft_window.append(req.stats.ttft_ms)
         text = req._inc_decode(tok)
         chunk = req.emit_text(text) if text else ""
         if chunk is None:  # stop string fired: suppress held-back text
@@ -266,11 +298,10 @@ class ModelRuntime:
                 req.finish(FinishReason.CANCELLED)
                 continue
             n = len(req.prompt_tokens)
-            bucket = self._bucket_for(n)
-            max_prompt = min(
-                bucket, self.ecfg.max_context - 1, self.cfg.max_seq_len - 1
-            )
-            if n > max_prompt:  # longer than bucket/context/model limit
+            # Prompts beyond the largest bucket stream through chunked
+            # prefill; the hard ceiling is the paged context itself.
+            max_prompt = min(self.ecfg.max_context - 1, self.cfg.max_seq_len - 1)
+            if n > max_prompt:
                 self.pending_prefill.popleft()
                 core.mark_dropped(req.user)  # mark_started ran at admission
                 req.finish(
@@ -291,23 +322,33 @@ class ModelRuntime:
             self.page_table[slot, :] = kvc.make_page_table_row(
                 pages, self.ecfg.max_pages_per_seq
             )
-            tokens = np.zeros((1, bucket), np.int32)
-            tokens[0, :n] = req.prompt_tokens
             s = req.sampling
+            largest = self.ecfg.prefill_buckets[-1]
             t0 = time.monotonic()
-            fn = self._get_prefill_jit(bucket)
-            tok, self.kc, self.vc = fn(
-                self.params,
-                jnp.asarray(tokens),
-                jnp.asarray([n], jnp.int32),
-                self.kc,
-                self.vc,
-                jnp.asarray(self.page_table[slot : slot + 1]),
+            pt_row = jnp.asarray(self.page_table[slot : slot + 1])
+            samp_args = (
                 jnp.asarray([s.temperature], jnp.float32),
                 jnp.asarray([s.top_k], jnp.int32),
                 jnp.asarray([s.top_p], jnp.float32),
-                self._next_key(),
             )
+            if n <= largest:
+                bucket = self._bucket_for(n)
+                tokens = np.zeros((1, bucket), np.int32)
+                tokens[0, :n] = req.prompt_tokens
+                fn = self._get_prefill_jit(bucket)
+                tok, self.kc, self.vc = fn(
+                    self.params, jnp.asarray(tokens), jnp.asarray([n], jnp.int32),
+                    self.kc, self.vc, pt_row, *samp_args, self._next_key(),
+                )
+            else:
+                # Long prompt: hand off to the incremental chunked-prefill
+                # path — ONE chunk per engine tick, so concurrent decode
+                # streams keep flowing during a multi-second prefill.
+                req._chunk_pos = 0
+                req._prefill_slot = slot
+                self.reserved_slots.add(slot)
+                self.chunking.append(req)
+                return True
             tok = int(np.asarray(tok)[0])
             self.prefill_latency_ms = (time.monotonic() - t0) * 1e3
 
@@ -323,6 +364,64 @@ class ModelRuntime:
                 self.seq_lens[slot] = n  # decode will write at pos n
             return True
         return False
+
+    def step_chunk(self, core: MQCore) -> bool:
+        """Advance ONE chunk of one long-prompt prefill. Returns True if a
+        chunk ran (the engine loop interleaves these with decode steps)."""
+        if not self.chunking:
+            return False
+        req = self.chunking[0]
+        slot = req._prefill_slot
+        largest = self.ecfg.prefill_buckets[-1]
+        n = len(req.prompt_tokens)
+
+        if req.cancelled.is_set() or req.stream.overflowed:
+            self.chunking.popleft()
+            self.alloc.free(self.slot_pages[slot])
+            self.page_table[slot, :] = kvc.TRASH_PAGE
+            self.reserved_slots.discard(slot)
+            core.mark_dropped(req.user)
+            req.finish(FinishReason.CANCELLED)
+            return True
+
+        s = req.sampling
+        chunk_start = req._chunk_pos
+        piece = req.prompt_tokens[chunk_start:chunk_start + largest]
+        cl = len(piece)
+        tokens = np.zeros((1, largest), np.int32)
+        tokens[0, :cl] = piece
+        t0 = time.monotonic()
+        fn = self._get_chunk_jit(largest)
+        tok, self.kc, self.vc = fn(
+            self.params, jnp.asarray(tokens),
+            jnp.asarray([chunk_start], jnp.int32),
+            jnp.asarray([cl], jnp.int32),
+            self.kc, self.vc,
+            jnp.asarray(self.page_table[slot : slot + 1]),
+            jnp.asarray([s.temperature], jnp.float32),
+            jnp.asarray([s.top_k], jnp.int32),
+            jnp.asarray([s.top_p], jnp.float32),
+            self._next_key(),
+        )
+        self.prefill_latency_ms = (time.monotonic() - t0) * 1e3
+        req._chunk_pos = chunk_start + cl
+        if req._chunk_pos < n:
+            return True  # more chunks next tick
+
+        # Final chunk: install into the slot and emit the first token.
+        self.chunking.popleft()
+        self.reserved_slots.discard(slot)
+        tok = int(np.asarray(tok)[0])
+        self.slot_req[slot] = req
+        self.seq_lens[slot] = n
+        self.temp[slot] = s.temperature
+        self.top_k[slot] = s.top_k
+        self.top_p[slot] = s.top_p
+        self.tokens_generated += 1
+        if self._emit_token(slot, tok, core):
+            self.last_tokens[slot] = tok
+            self.seq_lens[slot] = n
+        return True
 
     def step_decode(self, core: MQCore, k_steps: int = 1) -> int:
         """Advance all active slots by up to k_steps tokens. Returns #tokens."""
@@ -359,6 +458,7 @@ class ModelRuntime:
         )
         toks = np.asarray(toks)  # [K, S]
         self.step_latency_ms = (time.monotonic() - t0) * 1e3 / k_steps
+        self.step_window.append(self.step_latency_ms)
 
         emitted = 0
         for k in range(k_steps):
@@ -379,6 +479,12 @@ class ModelRuntime:
                 self._finish_slot(i, FinishReason.CANCELLED, core)
 
     def stats(self) -> dict:
+        def pctl(window, q):
+            if not window:
+                return 0.0
+            xs = sorted(window)
+            return round(xs[min(len(xs) - 1, int(q * len(xs)))], 3)
+
         return {
             "model": self.name,
             "active_slots": self.active_count(),
@@ -387,7 +493,11 @@ class ModelRuntime:
             "pages_used": self.alloc.used_pages,
             "pages_total": self.alloc.num_pages - 1,
             "step_latency_ms": round(self.step_latency_ms, 3),
+            "step_p50_ms": pctl(self.step_window, 0.50),
+            "step_p99_ms": pctl(self.step_window, 0.99),
             "prefill_latency_ms": round(self.prefill_latency_ms, 3),
+            "ttft_p50_ms": pctl(self.ttft_window, 0.50),
+            "ttft_p99_ms": pctl(self.ttft_window, 0.99),
             "tokens_generated": self.tokens_generated,
             "param_bytes": self.param_bytes,
             "kv_bytes": self.kv_bytes,
@@ -621,6 +731,7 @@ class TPUEngine:
                     list(getattr(rt, "slot_req", []))
                     + list(getattr(rt, "active", []))
                     + list(getattr(rt, "pending_prefill", []))
+                    + list(getattr(rt, "chunking", []))
                     + list(getattr(rt, "pending", []))
                 )
                 for cand in holders:
@@ -757,6 +868,10 @@ class TPUEngine:
                         # TTFT first: drain pending prefills into free slots.
                         while rt.pending_prefill and rt.step_prefill(self.core):
                             did_work = True
+                        # One chunk of any long-prompt prefill per tick,
+                        # interleaved with decode below.
+                        if rt.step_chunk(self.core):
+                            did_work = True
                         if any(r is not None for r in rt.slot_req):
                             more_waiting = bool(rt.pending_prefill) or bool(
                                 self.core.total_queued()
@@ -792,11 +907,17 @@ class TPUEngine:
                         rt.slot_req[i] = None
                         self.core.mark_dropped(req.user)
                         req.finish(FinishReason.ERROR, error=msg)
-            pending = getattr(rt, "pending_prefill", None) or getattr(rt, "pending", [])
-            while pending:
-                req = pending.popleft()
-                self.core.mark_dropped(req.user)
-                req.finish(FinishReason.ERROR, error=msg)
+            for attr in ("pending_prefill", "chunking", "pending"):
+                pending = getattr(rt, attr, None)
+                while pending:
+                    req = pending.popleft()
+                    self.core.mark_dropped(req.user)
+                    req.finish(FinishReason.ERROR, error=msg)
+            if hasattr(rt, "reserved_slots"):
+                for slot in list(rt.reserved_slots):
+                    rt.alloc.free(rt.slot_pages[slot])
+                    rt.page_table[slot, :] = kvc.TRASH_PAGE
+                rt.reserved_slots.clear()
         except Exception:
             log.exception("error while failing runtime %s", rt.name)
 

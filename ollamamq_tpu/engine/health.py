@@ -82,11 +82,10 @@ class HealthMonitor:
 
     def _check_progress(self) -> bool:
         """True if the engine is making progress (or rightly idle)."""
-        tokens = sum(
-            getattr(rt, "tokens_generated", 0)
-            for rt in self.engine.runtimes.values()
-        )
-        has_work = any(rt.has_work() for rt in self.engine.runtimes.values()) or bool(
+        # Snapshot: /api/pull and /api/delete mutate runtimes concurrently.
+        runtimes = list(self.engine.runtimes.values())
+        tokens = sum(getattr(rt, "tokens_generated", 0) for rt in runtimes)
+        has_work = any(rt.has_work() for rt in runtimes) or bool(
             self.engine.core.total_queued()
         )
         last_tokens, last_ts = self._last_progress
@@ -98,21 +97,25 @@ class HealthMonitor:
 
     def _loop(self) -> None:
         while not self._stop.wait(self.period_s):
-            ok = self._probe_device()
-            if ok != self.device_online:
-                if ok:
-                    log.info("TPU device is back ONLINE")
-                else:
-                    log.error("TPU device probe FAILED (runtime hung or lost)")
-                self.device_online = ok
+            try:
+                ok = self._probe_device()
+                if ok != self.device_online:
+                    if ok:
+                        log.info("TPU device is back ONLINE")
+                    else:
+                        log.error("TPU device probe FAILED (runtime hung or lost)")
+                    self.device_online = ok
 
-            progressing = self._check_progress()
-            if not progressing and not self.engine_stalled:
-                log.error(
-                    "engine STALLED: %d queued, work pending, no tokens for %ds",
-                    self.engine.core.total_queued(), int(STALL_DEADLINE_S),
-                )
-            self.engine_stalled = not progressing
+                progressing = self._check_progress()
+                if not progressing and not self.engine_stalled:
+                    log.error(
+                        "engine STALLED: %d queued, work pending, no tokens for %ds",
+                        self.engine.core.total_queued(), int(STALL_DEADLINE_S),
+                    )
+                self.engine_stalled = not progressing
+            except Exception:
+                # The watchdog must outlive anything it watches.
+                log.exception("health check iteration failed")
 
     def status(self) -> dict:
         return {

@@ -27,6 +27,7 @@ from ollamamq_tpu.ops.attention import (
     causal_attention,
     bidirectional_attention,
     flat_slot_indices,
+    paged_chunk_attention,
     paged_decode_attention,
 )
 from ollamamq_tpu.ops.rope import apply_rope
@@ -159,6 +160,54 @@ def forward_prefill(
     last = jnp.clip(seq_lens - 1, 0, T - 1)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)  # [B,1,D]
     logits = _logits(params, cfg, x_last)[:, 0, :]  # [B, V]
+    return logits, k_cache, v_cache
+
+
+def forward_prefill_chunk(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, C] one chunk of the prompt, right-padded
+    start: jnp.ndarray,  # [B] global position of the chunk's first token
+    chunk_lens: jnp.ndarray,  # [B] valid tokens in this chunk
+    k_cache: jnp.ndarray,  # [L, S, Hk, hd] (donated)
+    v_cache: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, max_pages] — covers prefix AND chunk
+    page_size: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One chunk of a long prompt: writes the chunk's K/V into its pages,
+    attends over the previously-written prefix + the chunk itself
+    (paged_chunk_attention). Chaining chunks reproduces forward_prefill
+    exactly, lifting the prompt-length ceiling from the largest bucket to
+    the full paged context. Returns (last-valid-position logits, caches').
+    """
+    B, C = tokens.shape
+    x = params["embed"][tokens].astype(params["embed"].dtype)
+    positions = start[:, None] + jnp.broadcast_to(
+        jnp.arange(C, dtype=jnp.int32), (B, C)
+    )
+    slots = flat_slot_indices(page_table, positions, page_size)  # [B, C]
+
+    def body(carry, per_layer):
+        x = carry
+        lp, kc, vc = per_layer
+
+        def attn_fn(q, k, v):
+            nonlocal kc, vc
+            kc = kc.at[slots].set(k)
+            vc = vc.at[slots].set(v)
+            return paged_chunk_attention(
+                q, kc, vc, page_table, start, chunk_lens, page_size
+            )
+
+        x, _, _ = _layer_step(cfg, lp, x, positions, attn_fn)
+        return x, (kc, vc)
+
+    x, (k_cache, v_cache) = jax.lax.scan(
+        body, x, (params["layers"], k_cache, v_cache)
+    )
+    last = jnp.clip(chunk_lens - 1, 0, C - 1)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)
+    logits = _logits(params, cfg, x_last)[:, 0, :]
     return logits, k_cache, v_cache
 
 

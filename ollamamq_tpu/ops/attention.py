@@ -82,6 +82,43 @@ def flat_slot_indices(
     return page * page_size + positions % page_size
 
 
+def paged_chunk_attention(
+    q: jnp.ndarray,  # [B, C, H, hd] — a chunk of new tokens per sequence
+    k_cache: jnp.ndarray,  # [S, Hk, hd] flat slot pool for ONE layer
+    v_cache: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, max_pages]
+    start: jnp.ndarray,  # [B] global position of the chunk's first token
+    chunk_lens: jnp.ndarray,  # [B] valid tokens in this chunk (<= C)
+    page_size: int,
+) -> jnp.ndarray:
+    """Chunked-prefill attention: the chunk's K/V are already scattered
+    into the cache, so each query at global position start+i attends to
+    cache positions <= start+i. Generalizes decode attention (C == 1).
+    """
+    B, C, H, hd = q.shape
+    max_pages = page_table.shape[1]
+    L = max_pages * page_size
+    positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (B, L))
+    slots = flat_slot_indices(page_table, positions, page_size)  # [B, L]
+    k = k_cache[slots]  # [B, L, Hk, hd]
+    v = v_cache[slots]
+    n_rep = H // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+    logits = jnp.einsum(
+        "bchd,blhd->bhcl", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale  # [B, H, C, L]
+    q_pos = start[:, None] + jnp.arange(C)[None, :]  # [B, C] global positions
+    causal = positions[:, None, :] <= q_pos[:, :, None]  # [B, C, L]
+    in_seq = positions[:, None, :] < (start + chunk_lens)[:, None, None]
+    mask = (causal & in_seq)[:, None, :, :]
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhcl,blhd->bchd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
 def paged_decode_attention(
     q: jnp.ndarray,  # [B, H, hd] one new token per sequence
     k_cache: jnp.ndarray,  # [S, Hk, hd] flat slot pool for ONE layer

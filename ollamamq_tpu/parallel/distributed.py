@@ -30,8 +30,11 @@ def initialize(
     """Initialize the multi-host control plane. No-ops for single-process.
 
     Args fall back to the standard env vars (JAX_COORDINATOR_ADDRESS,
-    JAX_NUM_PROCESSES, JAX_PROCESS_ID) or TPU-pod auto-detection.
-    Returns True if a multi-process runtime was initialized.
+    JAX_NUM_PROCESSES, JAX_PROCESS_ID). Multi-host is strictly OPT-IN via
+    those vars (or explicit args): a bare jax.distributed.initialize()
+    auto-detect is NOT attempted, because on a plain single host it can
+    hang waiting for a coordinator. Returns True if a multi-process
+    runtime was initialized.
     """
     coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
     env_np = os.environ.get("JAX_NUM_PROCESSES")

@@ -62,6 +62,7 @@ class Server:
         self.timeout_s = timeout_s
         self.allow_all_routes = allow_all_routes
         self.started_at = time.time()
+        self._profiling = False
 
     # ------------------------------------------------------------------ app
     def build_app(self) -> web.Application:
@@ -89,6 +90,7 @@ class Server:
         r.add_route("*", "/v1/models", self.v1_models)
         r.add_route("*", "/v1/models/{model}", self.v1_model)
         r.add_route("GET", "/metrics", self.metrics)  # TPU-era observability
+        r.add_route("POST", "/debug/profile", self.debug_profile)
         if self.allow_all_routes:
             r.add_route("*", "/{tail:.*}", self.fallback)
         return app
@@ -219,6 +221,41 @@ class Server:
 
     async def metrics(self, request: web.Request) -> web.Response:
         return web.json_response(self.engine.stats())
+
+    async def debug_profile(self, request: web.Request) -> web.Response:
+        """Capture a jax.profiler trace of the live engine for N seconds
+        (the tracing/profiling subsystem the reference lacks entirely).
+        View with TensorBoard / xprof.
+
+        The output directory is operator-controlled (OLLAMAMQ_PROFILE_DIR
+        env, never the request body), duration is clamped to [0.1, 30] s,
+        and only one trace runs at a time.
+        """
+        import os
+
+        body = await self._body_json(request)
+        try:
+            seconds = max(0.1, min(float(body.get("seconds", 3.0)), 30.0))
+        except (TypeError, ValueError):
+            raise ApiError(400, "'seconds' must be a number")
+        out_dir = os.environ.get("OLLAMAMQ_PROFILE_DIR", "/tmp/ollamamq-profile")
+        if self._profiling:
+            raise ApiError(409, "a profile capture is already running")
+        self._profiling = True
+
+        def run_trace():
+            import jax
+
+            jax.profiler.start_trace(out_dir)
+            time.sleep(seconds)
+            jax.profiler.stop_trace()
+
+        try:
+            await asyncio.get_running_loop().run_in_executor(None, run_trace)
+        finally:
+            self._profiling = False
+        return web.json_response({"status": "success", "trace_dir": out_dir,
+                                  "seconds": seconds})
 
     # ------------------------------------------------------------- /api/*
     async def api_generate(self, request: web.Request) -> web.StreamResponse:

@@ -45,11 +45,23 @@ send_one() {
 }
 
 send_and_cancel() {
+  # Body shape matches the endpoint (a /v1/* cancel with an /api/* body
+  # would just 400 and never exercise cancellation).
   local user="$1" endpoint="$2" model="$3"
+  local body
+  case "$endpoint" in
+    /api/generate)
+      body="{\"model\":\"$model\",\"prompt\":\"to be cancelled\",\"stream\":true,\"options\":{\"num_predict\":512}}" ;;
+    /api/chat)
+      body="{\"model\":\"$model\",\"stream\":true,\"messages\":[{\"role\":\"user\",\"content\":\"cancel me\"}],\"options\":{\"num_predict\":512}}" ;;
+    /v1/chat/completions)
+      body="{\"model\":\"$model\",\"stream\":true,\"max_tokens\":512,\"messages\":[{\"role\":\"user\",\"content\":\"cancel me\"}]}" ;;
+    /v1/completions)
+      body="{\"model\":\"$model\",\"stream\":true,\"max_tokens\":512,\"prompt\":\"to be cancelled\"}" ;;
+  esac
   curl -sS -X POST "http://${TARGET}${endpoint}" \
        -H "Content-Type: application/json" -H "X-User-ID: ${user}" \
-       -d "{\"model\":\"$model\",\"prompt\":\"to be cancelled\",\"stream\":true,\"options\":{\"num_predict\":512}}" \
-       >/dev/null 2>&1 &
+       -d "$body" >/dev/null 2>&1 &
   local cpid=$!
   sleep 0.3
   kill "$cpid" 2>/dev/null

@@ -297,3 +297,82 @@ def test_processing_gauge_not_corrupted_by_precancel():
         collect(r1, timeout=20)
     finally:
         eng.stop()
+
+
+def test_long_prompt_chunked_prefill(engine):
+    """Prompts beyond the largest bucket stream through chunked prefill
+    (ceiling is now the paged context, not the bucket)."""
+    # buckets max 64; max_context 128 => a 100-token prompt must work.
+    items, req = run_request(engine, prompt="z" * 97, max_tokens=4)  # 98 tokens
+    assert items[-1].kind == "done"
+    assert len(req.generated_ids) >= 1
+    # Deterministic equivalence: same text via the short path is impossible
+    # (>bucket), but the engine must still be consistent run to run.
+    items2, req2 = run_request(engine, prompt="z" * 97, max_tokens=4)
+    assert req.generated_ids == req2.generated_ids
+
+
+def test_chunked_prefill_interleaves_with_decode():
+    """A long-prompt prefill must not starve concurrent decode streams:
+    chunks advance one per tick while other slots keep decoding."""
+    eng = TPUEngine(
+        small_cfg(num_pages=256, max_pages_per_seq=32, prefill_buckets=(16,),
+                  decode_steps_per_iter=1),
+        blocklist_path=None,
+    )
+    eng.start()
+    try:
+        rt = eng.runtimes["test-tiny"]
+        rt.tokenizer.eos_id = -1
+        tok = rt.tokenizer
+        # A short request starts decoding first...
+        r1 = eng.enqueue_request("short", "", "test-tiny",
+                                 prompt_tokens=tok.encode("hi"),
+                                 sampling=SamplingParams(max_tokens=200))
+        deadline = time.monotonic() + 60
+        while not r1.stats.first_token_at and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert r1.stats.first_token_at
+        n_before = len(r1.generated_ids)
+        # ...then a long prompt (> bucket 16) arrives and chunk-prefills.
+        r2 = eng.enqueue_request("long", "", "test-tiny",
+                                 prompt_tokens=tok.encode("w" * 120),
+                                 sampling=SamplingParams(max_tokens=3))
+        items2 = collect(r2)
+        assert items2[-1].kind == "done"
+        # The short request kept decoding during the chunked prefill.
+        assert len(r1.generated_ids) > n_before
+        eng.cancel(r1.req_id)
+        collect(r1)
+    finally:
+        eng.stop()
+
+
+def test_cancel_during_chunked_prefill():
+    """Cancelling mid-chunk frees the reserved slot and its pages."""
+    eng = TPUEngine(
+        small_cfg(num_pages=256, max_pages_per_seq=32, prefill_buckets=(16,)),
+        blocklist_path=None,
+    )
+    eng.start()
+    try:
+        rt = eng.runtimes["test-tiny"]
+        tok = rt.tokenizer
+        free_before = rt.alloc.free_pages
+        req = eng.enqueue_request("c", "", "test-tiny",
+                                  prompt_tokens=tok.encode("w" * 200),
+                                  sampling=SamplingParams(max_tokens=3))
+        # Wait until chunking started, then cancel.
+        deadline = time.monotonic() + 60
+        while not rt.chunking and time.monotonic() < deadline:
+            time.sleep(0.005)
+        eng.cancel(req.req_id)
+        items = collect(req)
+        assert items[-1].finish_reason in (FinishReason.CANCELLED,)
+        deadline = time.monotonic() + 10
+        while rt.alloc.free_pages < free_before and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert rt.alloc.free_pages == free_before
+        assert not rt.reserved_slots
+    finally:
+        eng.stop()

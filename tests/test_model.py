@@ -169,3 +169,60 @@ def test_encoder_embeddings():
         params, cfg, jnp.array([[1, 2, 3]], jnp.int32), jnp.array([3])
     )
     np.testing.assert_allclose(np.asarray(emb[0]), np.asarray(emb2[0]), rtol=1e-4, atol=1e-5)
+
+
+def test_chunked_prefill_equivalence(tiny_cfg, tiny_params):
+    """Chaining forward_prefill_chunk chunks == one-shot forward_prefill."""
+    cfg, params = tiny_cfg, tiny_params
+    T, C = 24, 8  # 3 chunks
+    toks = jax.random.randint(jax.random.PRNGKey(7), (1, T), 0, cfg.vocab_size,
+                              dtype=jnp.int32)
+    a = kvc.PageAllocator(32, PAGE_SIZE, MAX_PAGES)
+    pages = a.alloc(T)
+    pt = _page_table(a, [pages])
+
+    kc, vc = _fresh_cache(cfg)
+    ref_logits, ref_kc, ref_vc = llama.forward_prefill(
+        params, cfg, toks, jnp.array([T]), kc, vc, pt, PAGE_SIZE
+    )
+
+    kc2, vc2 = _fresh_cache(cfg)
+    for start in range(0, T, C):
+        chunk = toks[:, start:start + C]
+        logits, kc2, vc2 = llama.forward_prefill_chunk(
+            params, cfg, chunk, jnp.array([start]), jnp.array([C]),
+            kc2, vc2, pt, PAGE_SIZE,
+        )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(kc2), np.asarray(ref_kc), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_chunked_prefill_ragged_last_chunk(tiny_cfg, tiny_params):
+    """Last chunk shorter than the chunk bucket (padding masked)."""
+    cfg, params = tiny_cfg, tiny_params
+    T, C = 19, 8  # chunks of 8, 8, 3
+    toks = jax.random.randint(jax.random.PRNGKey(8), (1, T), 0, cfg.vocab_size,
+                              dtype=jnp.int32)
+    a = kvc.PageAllocator(32, PAGE_SIZE, MAX_PAGES)
+    pt = _page_table(a, [a.alloc(T)])
+
+    kc, vc = _fresh_cache(cfg)
+    ref_logits, _, _ = llama.forward_prefill(
+        params, cfg, toks, jnp.array([T]), kc, vc, pt, PAGE_SIZE
+    )
+    kc2, vc2 = _fresh_cache(cfg)
+    for start in range(0, T, C):
+        piece = np.zeros((1, C), np.int32)
+        cl = min(C, T - start)
+        piece[0, :cl] = np.asarray(toks[0, start:start + cl])
+        logits, kc2, vc2 = llama.forward_prefill_chunk(
+            params, cfg, jnp.asarray(piece), jnp.array([start]), jnp.array([cl]),
+            kc2, vc2, pt, PAGE_SIZE,
+        )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
+    )
