@@ -105,7 +105,10 @@ class ModelRuntime:
         self.pending_prefill: collections.deque = collections.deque()
         # Long prompts mid-chunked-prefill (one chunk advanced per tick).
         self.chunking: collections.deque = collections.deque()
-        self._prefill_jits: Dict[int, callable] = {}
+        # Requests inside a prefill forward right now (cancel() must still
+        # find them; installation re-checks the cancelled flag).
+        self.inflight_prefill: List[Request] = []
+        self._prefill_jits: Dict[tuple, callable] = {}  # (bucket, B) | ("chunk", C)
         self._decode_jits: Dict[int, callable] = {}
         self._rng_counter = engine_cfg.seed
         # Ragged paged-attention Pallas kernel on TPU; jnp gather fallback
@@ -173,8 +176,9 @@ class ModelRuntime:
         self._rng_counter += 1
         return jax.random.PRNGKey(self._rng_counter)
 
-    def _get_prefill_jit(self, bucket: int):
-        if bucket not in self._prefill_jits:
+    def _get_prefill_jit(self, bucket: int, batch: int = 1):
+        key_ = (bucket, batch)
+        if key_ not in self._prefill_jits:
             cfg, ps = self.cfg, self.ecfg.page_size
 
             def fn(params, tokens, seq_lens, kc, vc, pt, temp, tk, tp, key):
@@ -184,8 +188,8 @@ class ModelRuntime:
                 tok = sample_tokens(logits, key, temp, tk, tp)
                 return tok, kc, vc
 
-            self._prefill_jits[bucket] = jax.jit(fn, donate_argnums=(3, 4))
-        return self._prefill_jits[bucket]
+            self._prefill_jits[key_] = jax.jit(fn, donate_argnums=(3, 4))
+        return self._prefill_jits[key_]
 
     def _get_chunk_jit(self, chunk: int):
         """Chunked prefill step for prompts longer than the largest bucket:
@@ -288,9 +292,18 @@ class ModelRuntime:
         return True
 
     # -- steps -------------------------------------------------------------
+    MAX_PREFILL_BATCH = 4
+
     def step_prefill(self, core: MQCore) -> bool:
-        """Admit one pending request into a free slot. Returns True if ran."""
-        while self.pending_prefill:
+        """Admit pending requests into free slots. Same-bucket prompts
+        prefill TOGETHER in one forward (up to MAX_PREFILL_BATCH), which
+        collapses the cold-start TTFT of a burst of arrivals. Long prompts
+        hand off to the incremental chunked path. Returns True if ran."""
+        batch: List[tuple] = []  # (req, slot, pages, n)
+        bucket = None
+        claimed: set = set()
+        largest = self.ecfg.prefill_buckets[-1]
+        while self.pending_prefill and len(batch) < self.MAX_PREFILL_BATCH:
             req = self.pending_prefill[0]
             if req.cancelled.is_set():
                 self.pending_prefill.popleft()
@@ -309,61 +322,116 @@ class ModelRuntime:
                     error=f"prompt length {n} exceeds maximum {max_prompt}",
                 )
                 continue
-            slot = next((i for i, r in enumerate(self.slot_req) if r is None), None)
-            if slot is None:
-                return False
-            pages = self.alloc.alloc(n + 1)
-            if pages is None:
-                return False  # pool exhausted; retry after frees
-            self.pending_prefill.popleft()
-
-            req.stats.prefill_started_at = time.monotonic()
-            self.slot_pages[slot] = pages
-            self.page_table[slot, :] = kvc.make_page_table_row(
-                pages, self.ecfg.max_pages_per_seq
-            )
-            s = req.sampling
-            largest = self.ecfg.prefill_buckets[-1]
-            t0 = time.monotonic()
-            pt_row = jnp.asarray(self.page_table[slot : slot + 1])
-            samp_args = (
-                jnp.asarray([s.temperature], jnp.float32),
-                jnp.asarray([s.top_k], jnp.int32),
-                jnp.asarray([s.top_p], jnp.float32),
-            )
-            if n <= largest:
-                bucket = self._bucket_for(n)
-                tokens = np.zeros((1, bucket), np.int32)
-                tokens[0, :n] = req.prompt_tokens
-                fn = self._get_prefill_jit(bucket)
-                tok, self.kc, self.vc = fn(
-                    self.params, jnp.asarray(tokens), jnp.asarray([n], jnp.int32),
-                    self.kc, self.vc, pt_row, *samp_args, self._next_key(),
+            if n > largest:
+                if batch:
+                    break  # run the collected batch first; chunk next tick
+                slot = self._claim_slot(claimed)
+                if slot is None:
+                    return False
+                pages = self.alloc.alloc(n + 1)
+                if pages is None:
+                    return False
+                self.pending_prefill.popleft()
+                req.stats.prefill_started_at = time.monotonic()
+                self.slot_pages[slot] = pages
+                self.page_table[slot, :] = kvc.make_page_table_row(
+                    pages, self.ecfg.max_pages_per_seq
                 )
-            else:
-                # Long prompt: hand off to the incremental chunked-prefill
-                # path — ONE chunk per engine tick, so concurrent decode
-                # streams keep flowing during a multi-second prefill.
+                # Incremental chunked prefill: ONE chunk per engine tick so
+                # concurrent decode streams keep flowing.
                 req._chunk_pos = 0
                 req._prefill_slot = slot
                 self.reserved_slots.add(slot)
                 self.chunking.append(req)
                 return True
-            tok = int(np.asarray(tok)[0])
-            self.prefill_latency_ms = (time.monotonic() - t0) * 1e3
+            b = self._bucket_for(n)
+            if bucket is None:
+                bucket = b
+            elif b != bucket:
+                break  # different bucket: next tick's batch
+            slot = self._claim_slot(claimed)
+            if slot is None:
+                break
+            pages = self.alloc.alloc(n + 1)
+            if pages is None:
+                break  # pool exhausted; run what we have, retry after frees
+            self.pending_prefill.popleft()
+            req.stats.prefill_started_at = time.monotonic()
+            self.slot_pages[slot] = pages
+            self.page_table[slot, :] = kvc.make_page_table_row(
+                pages, self.ecfg.max_pages_per_seq
+            )
+            claimed.add(slot)
+            batch.append((req, slot, pages, n))
 
+        if not batch:
+            return False
+
+        # Pad multi-request batches to the fixed MAX so each bucket compiles
+        # at most twice (B=1 for sparse traffic, B=MAX for bursts); padding
+        # rows use trash-page tables and zero lengths, so the extra compute
+        # is bounded and writes land in the trash page.
+        B = 1 if len(batch) == 1 else self.MAX_PREFILL_BATCH
+        pt_rows = np.full(
+            (B, self.ecfg.max_pages_per_seq), kvc.TRASH_PAGE, np.int32
+        )
+        tokens = np.zeros((B, bucket), np.int32)
+        lens = np.zeros((B,), np.int32)
+        temp = np.zeros((B,), np.float32)
+        top_k = np.zeros((B,), np.int32)
+        top_p = np.ones((B,), np.float32)
+        for i, (req, slot, _, n) in enumerate(batch):
+            tokens[i, :n] = req.prompt_tokens
+            lens[i] = n
+            temp[i] = req.sampling.temperature
+            top_k[i] = req.sampling.top_k
+            top_p[i] = req.sampling.top_p
+            pt_rows[i] = self.page_table[slot]
+        self.inflight_prefill = [req for req, *_ in batch]
+        t0 = time.monotonic()
+        try:
+            fn = self._get_prefill_jit(bucket, B)
+            toks, self.kc, self.vc = fn(
+                self.params, jnp.asarray(tokens), jnp.asarray(lens),
+                self.kc, self.vc, jnp.asarray(pt_rows),
+                jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+                self._next_key(),
+            )
+            toks = np.asarray(toks)
+        except Exception as e:
+            # Fail ONLY this batch: free its pages, error its requests —
+            # never leave a client hanging or a page leaked.
+            for req, slot, pages, _ in batch:
+                self.alloc.free(pages)
+                self.page_table[slot, :] = kvc.TRASH_PAGE
+                core.mark_dropped(req.user)
+                req.finish(FinishReason.ERROR, error=f"prefill failed: {e}")
+            self.inflight_prefill = []
+            log.exception("batched prefill failed (bucket=%d B=%d)", bucket, B)
+            return True
+        finally:
+            self.inflight_prefill = []
+        self.prefill_latency_ms = (time.monotonic() - t0) * 1e3
+
+        for i, (req, slot, _, n) in enumerate(batch):
             self.slot_req[slot] = req
             self.seq_lens[slot] = n
-            self.temp[slot] = s.temperature
-            self.top_k[slot] = s.top_k
-            self.top_p[slot] = s.top_p
+            self.temp[slot] = req.sampling.temperature
+            self.top_k[slot] = req.sampling.top_k
+            self.top_p[slot] = req.sampling.top_p
             self.tokens_generated += 1
+            tok = int(toks[i])
             if self._emit_token(slot, tok, core):
                 # Token written at position n during the next decode step.
                 self.last_tokens[slot] = tok
-                self.seq_lens[slot] = n  # decode will write at pos n
-            return True
-        return False
+                self.seq_lens[slot] = n
+        return True
+
+    def _claim_slot(self, claimed: set) -> Optional[int]:
+        for i, r in enumerate(self.slot_req):
+            if r is None and i not in claimed and i not in self.reserved_slots:
+                return i
+        return None
 
     def step_chunk(self, core: MQCore) -> bool:
         """Advance ONE chunk of one long-prompt prefill. Returns True if a
@@ -732,6 +800,7 @@ class TPUEngine:
                     + list(getattr(rt, "active", []))
                     + list(getattr(rt, "pending_prefill", []))
                     + list(getattr(rt, "chunking", []))
+                    + list(getattr(rt, "inflight_prefill", []))
                     + list(getattr(rt, "pending", []))
                 )
                 for cand in holders:
