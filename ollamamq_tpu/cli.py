@@ -56,6 +56,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tensor-parallel axis size (-1 = all devices)")
     p.add_argument("--token-fairness", action="store_true",
                    help="fair-share by served tokens instead of request count")
+    p.add_argument("--spmd", action="store_true",
+                   help="multi-host SPMD serving: process 0 runs the "
+                        "scheduler+HTTP and broadcasts step plans; other "
+                        "processes replay them (requires jax.distributed "
+                        "env vars)")
     return p
 
 
@@ -110,7 +115,34 @@ def main(argv=None) -> int:
     )
     fairness = Fairness.TOKENS if args.token_fairness else Fairness.REQUESTS
 
-    if args.fake_engine:
+    if args.spmd and args.fake_engine:
+        log.error("--spmd and --fake-engine are mutually exclusive")
+        return 2
+    if args.spmd:
+        import jax
+
+        from ollamamq_tpu.parallel.mesh import make_mesh
+
+        # SPMD with an unspecified mesh means "the whole pod": default the
+        # tensor axis to all global devices so worker hosts own shards.
+        tp = args.tp
+        if (args.dp, args.sp, tp) == (1, 1, 1):
+            tp = -1
+        mesh = make_mesh(dp=args.dp, sp=args.sp, tp=tp)
+        if not distributed.is_primary():
+            # Worker host: replay the primary's step plans until shutdown.
+            from ollamamq_tpu.engine import spmd
+
+            log.info("SPMD worker %d starting for %s",
+                     jax.process_index(), model_names)
+            spmd.run_worker(models, ecfg, mesh)
+            return 0
+
+        from ollamamq_tpu.engine.spmd import SPMDEngine
+
+        engine = SPMDEngine(ecfg, models=models, blocklist_path=args.blocklist,
+                            fairness=fairness, mesh=mesh)
+    elif args.fake_engine:
         from ollamamq_tpu.engine.fake import FakeEngine
 
         engine = FakeEngine(ecfg, models=models, blocklist_path=args.blocklist,

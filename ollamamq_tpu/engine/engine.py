@@ -176,6 +176,25 @@ class ModelRuntime:
         self._rng_counter += 1
         return jax.random.PRNGKey(self._rng_counter)
 
+    # -- dispatch seams (SPMD subclass broadcasts before dispatching) ------
+    def _dispatch_prefill(self, bucket, B, tokens, lens, pt_rows, temp, tk, tp, key):
+        fn = self._get_prefill_jit(bucket, B)
+        return fn(self.params, jnp.asarray(tokens), jnp.asarray(lens),
+                  self.kc, self.vc, jnp.asarray(pt_rows),
+                  jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp), key)
+
+    def _dispatch_chunk(self, chunk, tokens, start, cl, pt_row, temp, tk, tp, key):
+        fn = self._get_chunk_jit(chunk)
+        return fn(self.params, jnp.asarray(tokens), jnp.asarray(start),
+                  jnp.asarray(cl), self.kc, self.vc, jnp.asarray(pt_row),
+                  jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp), key)
+
+    def _dispatch_decode(self, k_steps, tokens, positions, pt, temp, tk, tp, key):
+        fn = self._get_decode_jit(k_steps)
+        return fn(self.params, jnp.asarray(tokens), jnp.asarray(positions),
+                  self.kc, self.vc, jnp.asarray(pt),
+                  jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp), key)
+
     def _get_prefill_jit(self, bucket: int, batch: int = 1):
         key_ = (bucket, batch)
         if key_ not in self._prefill_jits:
@@ -390,11 +409,8 @@ class ModelRuntime:
         self.inflight_prefill = [req for req, *_ in batch]
         t0 = time.monotonic()
         try:
-            fn = self._get_prefill_jit(bucket, B)
-            toks, self.kc, self.vc = fn(
-                self.params, jnp.asarray(tokens), jnp.asarray(lens),
-                self.kc, self.vc, jnp.asarray(pt_rows),
-                jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
+            toks, self.kc, self.vc = self._dispatch_prefill(
+                bucket, B, tokens, lens, pt_rows, temp, top_k, top_p,
                 self._next_key(),
             )
             toks = np.asarray(toks)
@@ -459,16 +475,13 @@ class ModelRuntime:
         tokens = np.zeros((1, largest), np.int32)
         tokens[0, :cl] = piece
         t0 = time.monotonic()
-        fn = self._get_chunk_jit(largest)
-        tok, self.kc, self.vc = fn(
-            self.params, jnp.asarray(tokens),
-            jnp.asarray([chunk_start], jnp.int32),
-            jnp.asarray([cl], jnp.int32),
-            self.kc, self.vc,
-            jnp.asarray(self.page_table[slot : slot + 1]),
-            jnp.asarray([s.temperature], jnp.float32),
-            jnp.asarray([s.top_k], jnp.int32),
-            jnp.asarray([s.top_p], jnp.float32),
+        tok, self.kc, self.vc = self._dispatch_chunk(
+            largest, tokens,
+            np.asarray([chunk_start], np.int32), np.asarray([cl], np.int32),
+            self.page_table[slot : slot + 1],
+            np.asarray([s.temperature], np.float32),
+            np.asarray([s.top_k], np.int32),
+            np.asarray([s.top_p], np.float32),
             self._next_key(),
         )
         self.prefill_latency_ms = (time.monotonic() - t0) * 1e3
@@ -511,17 +524,10 @@ class ModelRuntime:
             return 0
 
         t0 = time.monotonic()
-        fn = self._get_decode_jit(k_steps)
-        toks, self.kc, self.vc = fn(
-            self.params,
-            jnp.asarray(self.last_tokens),
-            jnp.asarray(self.seq_lens),  # position of the incoming token
-            self.kc,
-            self.vc,
-            jnp.asarray(self.page_table),
-            jnp.asarray(self.temp),
-            jnp.asarray(self.top_k),
-            jnp.asarray(self.top_p),
+        toks, self.kc, self.vc = self._dispatch_decode(
+            k_steps, self.last_tokens,
+            self.seq_lens,  # position of the incoming token
+            self.page_table, self.temp, self.top_k, self.top_p,
             self._next_key(),
         )
         toks = np.asarray(toks)  # [K, S]
@@ -674,6 +680,10 @@ class EncoderRuntime:
 class TPUEngine:
     """Engine front: owns the scheduler core, model runtimes, and the loop."""
 
+    # Generative-runtime class; SPMD deployments swap in SPMDModelRuntime
+    # so every device dispatch is broadcast to worker hosts first.
+    runtime_class = ModelRuntime
+
     def __init__(
         self,
         engine_cfg: EngineConfig,
@@ -711,7 +721,7 @@ class TPUEngine:
             raise KeyError(f"unknown model architecture: {name}")
         if name in self.runtimes:
             return
-        cls = EncoderRuntime if cfg.is_encoder else ModelRuntime
+        cls = EncoderRuntime if cfg.is_encoder else self.runtime_class
         self.runtimes[name] = cls(
             name, cfg, self.ecfg, mesh=self.mesh,
             checkpoint_path=checkpoint_path, dtype=self.dtype,

@@ -1,0 +1,228 @@
+"""SPMD multi-host serving: one engine, many hosts.
+
+The reference scales by adding independent HTTP backends; a TPU pod is a
+single SPMD machine instead: every host runs the same program, params and
+KV pools are sharded over a GLOBAL mesh (tensor axis spanning hosts'
+chips), and each jitted step executes on all hosts with XLA collectives
+over ICI/DCN doing the cross-chip movement.
+
+Control plane: the primary host (process 0) owns the scheduler, HTTP
+front, and all admission decisions. Before every device step it
+broadcasts a "step plan" via `multihost_utils.broadcast_one_to_all` in
+two phases — a fixed-shape header (opcode + static dims), then the
+op-specific payload (token ids, page tables, sampling params, raw RNG
+key) — so both sides always issue matching collectives. Workers sit in
+`run_worker`, receive plans, and issue the SAME jit call with their
+local shards. Every value feeding the computation is broadcast, never
+recomputed locally, so all hosts trace and execute identical steps.
+
+Opcode header (int32[4]: [op, a, b, _]):
+    OP_SHUTDOWN = 0              -> workers exit (no payload)
+    OP_PREFILL  = 1, a=bucket, b=B
+    OP_CHUNK    = 2, a=chunk_size
+    OP_DECODE   = 3, a=k_steps
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ollamamq_tpu.config import EngineConfig, ModelConfig
+from ollamamq_tpu.engine.engine import ModelRuntime
+
+log = logging.getLogger("ollamamq.spmd")
+
+OP_SHUTDOWN = 0
+OP_PREFILL = 1
+OP_CHUNK = 2
+OP_DECODE = 3
+
+KEY_SHAPE = (2,)  # raw uint32 threefry key data
+
+
+def _bcast(tree):
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(tree)
+
+
+def broadcast_shutdown() -> None:
+    """Release worker hosts. Sent exactly ONCE per deployment (the worker
+    loop exits on the first shutdown header; further broadcasts would have
+    no receiver and deadlock the sender)."""
+    if jax.process_count() > 1:
+        _bcast(np.asarray([OP_SHUTDOWN, 0, 0, 0], np.int32))
+
+
+class SPMDModelRuntime(ModelRuntime):
+    """ModelRuntime whose device dispatches are mirrored on every host.
+
+    Single-process deployments behave exactly like ModelRuntime (the
+    broadcast seam is skipped entirely).
+    """
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._spmd = jax.process_count() > 1
+        # Ordinal agreed with workers via the shared --models ordering;
+        # carried in the opcode header so multi-model pods stay in step.
+        self.spmd_index = 0
+
+    def _dispatch_prefill(self, bucket, B, tokens, lens, pt_rows, temp, tk, tp, key):
+        if self._spmd:
+            _bcast(np.asarray([OP_PREFILL, bucket, B, self.spmd_index], np.int32))
+            _bcast((np.asarray(tokens, np.int32), np.asarray(lens, np.int32),
+                    np.asarray(pt_rows, np.int32), np.asarray(temp, np.float32),
+                    np.asarray(tk, np.int32), np.asarray(tp, np.float32),
+                    np.asarray(key, np.uint32)))
+        return super()._dispatch_prefill(
+            bucket, B, tokens, lens, pt_rows, temp, tk, tp, key
+        )
+
+    def _dispatch_chunk(self, chunk, tokens, start, cl, pt_row, temp, tk, tp, key):
+        if self._spmd:
+            _bcast(np.asarray([OP_CHUNK, chunk, 0, self.spmd_index], np.int32))
+            _bcast((np.asarray(tokens, np.int32), np.asarray(start, np.int32),
+                    np.asarray(cl, np.int32), np.asarray(pt_row, np.int32),
+                    np.asarray(temp, np.float32), np.asarray(tk, np.int32),
+                    np.asarray(tp, np.float32), np.asarray(key, np.uint32)))
+        return super()._dispatch_chunk(
+            chunk, tokens, start, cl, pt_row, temp, tk, tp, key
+        )
+
+    def _dispatch_decode(self, k_steps, tokens, positions, pt, temp, tk, tp, key):
+        if self._spmd:
+            _bcast(np.asarray([OP_DECODE, k_steps, 0, self.spmd_index], np.int32))
+            _bcast((np.asarray(tokens, np.int32), np.asarray(positions, np.int32),
+                    np.asarray(pt, np.int32), np.asarray(temp, np.float32),
+                    np.asarray(tk, np.int32), np.asarray(tp, np.float32),
+                    np.asarray(key, np.uint32)))
+        return super()._dispatch_decode(
+            k_steps, tokens, positions, pt, temp, tk, tp, key
+        )
+
+class SPMDEngine:
+    """Factory + lifecycle glue for the primary host: a TPUEngine whose
+    generative runtimes broadcast their dispatches, rejecting what the
+    worker protocol can't replay yet, and releasing workers on stop."""
+
+    def __new__(cls, *args, **kw):
+        from ollamamq_tpu.engine.engine import TPUEngine
+
+        class _Engine(TPUEngine):
+            runtime_class = SPMDModelRuntime
+
+            def load_model(self, name, checkpoint_path=None):
+                from ollamamq_tpu.config import get_model_config
+
+                cfg = get_model_config(name)
+                if cfg is not None and cfg.is_encoder:
+                    raise NotImplementedError(
+                        "embedding models are not supported under --spmd yet "
+                        "(no OP_ENCODE in the worker protocol)"
+                    )
+                if self._running and jax.process_count() > 1:
+                    raise NotImplementedError(
+                        "runtime model load (/api/pull) is not supported "
+                        "under --spmd; list all models at startup"
+                    )
+                super().load_model(name, checkpoint_path)
+                rt = self.runtimes.get(name)
+                if isinstance(rt, SPMDModelRuntime):
+                    rt.spmd_index = list(self.runtimes).index(name)
+
+            def stop(self):
+                super().stop()
+                broadcast_shutdown()  # exactly once, after dispatches ended
+
+        return _Engine(*args, **kw)
+
+
+def run_worker(
+    models,
+    engine_cfg: EngineConfig,
+    mesh,
+    dtype=jnp.bfloat16,
+    max_steps: Optional[int] = None,
+) -> int:
+    """Worker-host loop (process_id != 0): replay the primary's dispatches.
+
+    `models`: {name: checkpoint_path_or_None} in the SAME order as the
+    primary's --models list — the opcode header routes by that ordinal.
+    Returns the number of steps executed. `max_steps` bounds the loop for
+    tests; production workers run until OP_SHUTDOWN.
+    """
+    from ollamamq_tpu.config import get_model_config
+
+    runtimes = []
+    for name, ckpt in models.items():
+        cfg = get_model_config(name)
+        if cfg is None or cfg.is_encoder:
+            raise ValueError(f"model {name} not replayable under SPMD")
+        runtimes.append(
+            SPMDModelRuntime(name, cfg, engine_cfg, mesh=mesh,
+                             checkpoint_path=ckpt, dtype=dtype)
+        )
+    steps = 0
+    S = engine_cfg.max_slots
+    MP = engine_cfg.max_pages_per_seq
+
+    while max_steps is None or steps < max_steps:
+        header = _bcast(np.zeros(4, np.int32))
+        op = int(header[0])
+        if op == OP_SHUTDOWN:
+            break
+        rt = runtimes[int(header[3])] if int(header[3]) < len(runtimes) else runtimes[0]
+        try:
+            if op == OP_PREFILL:
+                bucket, B = int(header[1]), int(header[2])
+                tokens, lens, pt_rows, temp, tk, tp, key_data = _bcast((
+                    np.zeros((B, bucket), np.int32), np.zeros((B,), np.int32),
+                    np.zeros((B, MP), np.int32), np.zeros((B,), np.float32),
+                    np.zeros((B,), np.int32), np.ones((B,), np.float32),
+                    np.zeros(KEY_SHAPE, np.uint32),
+                ))
+                key = jnp.asarray(key_data, jnp.uint32)
+                _, rt.kc, rt.vc = ModelRuntime._dispatch_prefill(
+                    rt, bucket, B, tokens, lens, pt_rows, temp, tk, tp, key
+                )
+            elif op == OP_CHUNK:
+                chunk = int(header[1])
+                tokens, start, cl, pt_row, temp, tk, tp, key_data = _bcast((
+                    np.zeros((1, chunk), np.int32), np.zeros((1,), np.int32),
+                    np.zeros((1,), np.int32), np.zeros((1, MP), np.int32),
+                    np.zeros((1,), np.float32), np.zeros((1,), np.int32),
+                    np.ones((1,), np.float32), np.zeros(KEY_SHAPE, np.uint32),
+                ))
+                key = jnp.asarray(key_data, jnp.uint32)
+                _, rt.kc, rt.vc = ModelRuntime._dispatch_chunk(
+                    rt, chunk, tokens, start, cl, pt_row, temp, tk, tp, key
+                )
+            elif op == OP_DECODE:
+                k_steps = int(header[1])
+                tokens, positions, pt, temp, tk, tp, key_data = _bcast((
+                    np.zeros((S,), np.int32), np.zeros((S,), np.int32),
+                    np.zeros((S, MP), np.int32), np.zeros((S,), np.float32),
+                    np.zeros((S,), np.int32), np.ones((S,), np.float32),
+                    np.zeros(KEY_SHAPE, np.uint32),
+                ))
+                key = jnp.asarray(key_data, jnp.uint32)
+                _, rt.kc, rt.vc = ModelRuntime._dispatch_decode(
+                    rt, k_steps, tokens, positions, pt, temp, tk, tp, key
+                )
+            else:
+                log.error("unknown opcode %d; shutting down", op)
+                break
+        except Exception:
+            # The primary recovers from a failed step (errors the batch and
+            # keeps serving); the worker must stay in lock-step with it
+            # rather than die and deadlock the next broadcast.
+            log.exception("worker step failed (op=%d); continuing", op)
+        steps += 1
+    return steps
