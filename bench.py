@@ -100,8 +100,20 @@ def main() -> int:
         rt.step_prefill(core)
     active = rt.active_count()
 
-    # Warmup (compiles the decode chunk).
-    rt.step_decode(core, k_steps=args.chunk)
+    # Warmup (compiles the decode chunk). If the Pallas kernel fails to
+    # compile on this hardware, fall back to the jnp attention path rather
+    # than losing the benchmark run.
+    try:
+        rt.step_decode(core, k_steps=args.chunk)
+    except Exception as e:
+        if rt.attn_impl == "pallas":
+            print(f"# pallas path failed ({type(e).__name__}); falling back to jnp",
+                  file=sys.stderr)
+            rt.attn_impl = "jnp"
+            rt._decode_jits.clear()
+            rt.step_decode(core, k_steps=args.chunk)
+        else:
+            raise
     warm_remaining = max(0, args.warmup_steps - args.chunk)
     while warm_remaining > 0:
         rt.step_decode(core, k_steps=args.chunk)
@@ -134,6 +146,7 @@ def main() -> int:
         "ttft_p50_ms": round(ttft_p50_ms, 1),
         "ttft_compile_ms": round(ttft_compile_ms, 1),
         "init_s": round(init_s, 1),
+        "attn_impl": rt.attn_impl,
     }
     print(json.dumps(result))
     return 0

@@ -408,3 +408,37 @@ def test_batched_prefill_same_results_as_serial():
     serial = run(burst=False)
     burst = run(burst=True)
     assert serial == burst
+
+
+def test_kv_pool_pressure_waits_and_recovers():
+    """More demand than KV pages: excess requests wait (not fail), then get
+    served as pages free — the capacity analogue of 'stuck in queue'."""
+    # Pool: 15 usable pages; each request needs ~2 (prompt+headroom), and
+    # decode extends. 8 concurrent requests oversubscribe the pool.
+    eng = TPUEngine(
+        small_cfg(max_slots=8, num_pages=16, max_pages_per_seq=4,
+                  decode_steps_per_iter=1),
+        blocklist_path=None,
+    )
+    eng.start()
+    try:
+        tok = eng.runtimes["test-tiny"].tokenizer
+        reqs = []
+        for i in range(8):
+            reqs.append(eng.enqueue_request(
+                f"p{i}", "", "test-tiny",
+                prompt_tokens=tok.encode(f"pressure {i}"),
+                sampling=SamplingParams(max_tokens=12),
+            ))
+        done = 0
+        for r in reqs:
+            items = collect(r, timeout=120)
+            assert items[-1].kind == "done", items[-1]
+            done += 1
+        assert done == 8
+        rt = eng.runtimes["test-tiny"]
+        assert rt.alloc.used_pages == 0  # everything reclaimed
+        snap = eng.core.snapshot()
+        assert all(snap["users"][f"p{i}"]["processed"] == 1 for i in range(8))
+    finally:
+        eng.stop()
