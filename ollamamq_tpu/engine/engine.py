@@ -43,7 +43,7 @@ from ollamamq_tpu.core import MQCore, Fairness, Family
 from ollamamq_tpu.core.mqcore import StuckQueue
 from ollamamq_tpu.engine import kv_cache as kvc
 from ollamamq_tpu.engine.request import FinishReason, Request, StreamItem
-from ollamamq_tpu.engine.tokenizer import ByteTokenizer, load_tokenizer
+from ollamamq_tpu.engine.tokenizer import load_tokenizer
 from ollamamq_tpu.models import llama, weights
 from ollamamq_tpu.ops.sampling import sample_tokens
 from ollamamq_tpu.parallel.mesh import make_mesh, validate_tp_for_model
@@ -260,8 +260,7 @@ class ModelRuntime:
         req = self.slot_req[slot]
         if req is None:
             return
-        self.alloc.free(self.slot_pages[slot])
-        self.page_table[slot, :] = kvc.TRASH_PAGE
+        self._release_slot_pages(slot)
         self.seq_lens[slot] = 0
         self.temp[slot] = 0.0
         self.top_k[slot] = 0
@@ -418,8 +417,7 @@ class ModelRuntime:
             # Fail ONLY this batch: free its pages, error its requests —
             # never leave a client hanging or a page leaked.
             for req, slot, pages, _ in batch:
-                self.alloc.free(pages)
-                self.page_table[slot, :] = kvc.TRASH_PAGE
+                self._release_slot_pages(slot)
                 core.mark_dropped(req.user)
                 req.finish(FinishReason.ERROR, error=f"prefill failed: {e}")
             self.inflight_prefill = []
@@ -430,17 +428,7 @@ class ModelRuntime:
         self.prefill_latency_ms = (time.monotonic() - t0) * 1e3
 
         for i, (req, slot, _, n) in enumerate(batch):
-            self.slot_req[slot] = req
-            self.seq_lens[slot] = n
-            self.temp[slot] = req.sampling.temperature
-            self.top_k[slot] = req.sampling.top_k
-            self.top_p[slot] = req.sampling.top_p
-            self.tokens_generated += 1
-            tok = int(toks[i])
-            if self._emit_token(slot, tok, core):
-                # Token written at position n during the next decode step.
-                self.last_tokens[slot] = tok
-                self.seq_lens[slot] = n
+            self._install_slot(slot, req, n, int(toks[i]), core)
         return True
 
     def _claim_slot(self, claimed: set) -> Optional[int]:
@@ -448,6 +436,26 @@ class ModelRuntime:
             if r is None and i not in claimed and i not in self.reserved_slots:
                 return i
         return None
+
+    def _release_slot_pages(self, slot: int) -> None:
+        """Free a slot's KV pages and reset its page-table row."""
+        self.alloc.free(self.slot_pages[slot])
+        self.page_table[slot, :] = kvc.TRASH_PAGE
+
+    def _install_slot(self, slot: int, req: Request, n: int, tok: int,
+                      core: MQCore) -> None:
+        """Activate a freshly prefilled request in its decode slot and emit
+        the first sampled token."""
+        self.slot_req[slot] = req
+        self.seq_lens[slot] = n
+        self.temp[slot] = req.sampling.temperature
+        self.top_k[slot] = req.sampling.top_k
+        self.top_p[slot] = req.sampling.top_p
+        self.tokens_generated += 1
+        if self._emit_token(slot, tok, core):
+            # Token written at position n during the next decode step.
+            self.last_tokens[slot] = tok
+            self.seq_lens[slot] = n
 
     def step_chunk(self, core: MQCore) -> bool:
         """Advance ONE chunk of one long-prompt prefill. Returns True if a
@@ -461,8 +469,7 @@ class ModelRuntime:
 
         if req.cancelled.is_set() or req.stream.overflowed:
             self.chunking.popleft()
-            self.alloc.free(self.slot_pages[slot])
-            self.page_table[slot, :] = kvc.TRASH_PAGE
+            self._release_slot_pages(slot)
             self.reserved_slots.discard(slot)
             core.mark_dropped(req.user)
             req.finish(FinishReason.CANCELLED)
@@ -492,16 +499,7 @@ class ModelRuntime:
         # Final chunk: install into the slot and emit the first token.
         self.chunking.popleft()
         self.reserved_slots.discard(slot)
-        tok = int(np.asarray(tok)[0])
-        self.slot_req[slot] = req
-        self.seq_lens[slot] = n
-        self.temp[slot] = s.temperature
-        self.top_k[slot] = s.top_k
-        self.top_p[slot] = s.top_p
-        self.tokens_generated += 1
-        if self._emit_token(slot, tok, core):
-            self.last_tokens[slot] = tok
-            self.seq_lens[slot] = n
+        self._install_slot(slot, req, n, int(np.asarray(tok)[0]), core)
         return True
 
     def step_decode(self, core: MQCore, k_steps: int = 1) -> int:
@@ -980,8 +978,7 @@ class TPUEngine:
             if isinstance(rt, ModelRuntime):
                 for i, req in enumerate(rt.slot_req):
                     if req is not None:
-                        rt.alloc.free(rt.slot_pages[i])
-                        rt.page_table[i, :] = kvc.TRASH_PAGE
+                        rt._release_slot_pages(i)
                         rt.seq_lens[i] = 0
                         rt.slot_req[i] = None
                         self.core.mark_dropped(req.user)
@@ -994,8 +991,7 @@ class TPUEngine:
                     req.finish(FinishReason.ERROR, error=msg)
             if hasattr(rt, "reserved_slots"):
                 for slot in list(rt.reserved_slots):
-                    rt.alloc.free(rt.slot_pages[slot])
-                    rt.page_table[slot, :] = kvc.TRASH_PAGE
+                    rt._release_slot_pages(slot)
                 rt.reserved_slots.clear()
         except Exception:
             log.exception("error while failing runtime %s", rt.name)

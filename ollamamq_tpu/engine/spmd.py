@@ -33,7 +33,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ollamamq_tpu.config import EngineConfig, ModelConfig
+from ollamamq_tpu.config import EngineConfig
 from ollamamq_tpu.engine.engine import ModelRuntime
 
 log = logging.getLogger("ollamamq.spmd")

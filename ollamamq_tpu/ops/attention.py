@@ -129,25 +129,14 @@ def paged_decode_attention(
 ) -> jnp.ndarray:
     """Decode attention: each query attends to its own paged context.
 
-    jnp reference path: gathers the full (padded) context per sequence.
-    The Pallas kernel replaces this with per-page reads and no
-    materialization.
+    The C == 1 case of paged_chunk_attention (the new token sits at
+    position seq_len-1 and sees everything before it). jnp reference
+    path — on TPU the Pallas kernel replaces it with per-page reads and
+    no materialization.
     """
-    B, H, hd = q.shape
-    max_pages = page_table.shape[1]
-    L = max_pages * page_size
-    positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (B, L))
-    slots = flat_slot_indices(page_table, positions, page_size)  # [B, L]
-    k = k_cache[slots]  # [B, L, Hk, hd]
-    v = v_cache[slots]
-    n_rep = H // k.shape[2]
-    k = repeat_kv(k, n_rep)
-    v = repeat_kv(v, n_rep)
-    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
-    logits = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32), k.astype(jnp.float32))
-    logits = logits * scale
-    valid = positions < seq_lens[:, None]
-    logits = jnp.where(valid[:, None, :], logits, NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhl,blhd->bhd", probs, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    out = paged_chunk_attention(
+        q[:, None], k_cache, v_cache, page_table,
+        start=seq_lens - 1, chunk_lens=jnp.ones_like(seq_lens),
+        page_size=page_size,
+    )
+    return out[:, 0]

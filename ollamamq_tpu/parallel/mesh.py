@@ -16,7 +16,6 @@ ollamamq_tpu.parallel.distributed; this module only arranges whatever
 
 from __future__ import annotations
 
-import math
 from typing import Optional, Sequence
 
 import jax

@@ -22,7 +22,7 @@ import json
 import logging
 import time
 import uuid
-from typing import Optional
+
 
 from aiohttp import web
 

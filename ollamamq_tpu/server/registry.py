@@ -16,7 +16,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from ollamamq_tpu.config import MODEL_CONFIGS, ModelConfig, get_model_config, smart_match
+from ollamamq_tpu.config import ModelConfig, get_model_config, smart_match
 
 
 @dataclasses.dataclass
