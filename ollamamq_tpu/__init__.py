@@ -13,3 +13,29 @@ Reference capability map: /root/reference/src/{main,dispatcher,tui}.rs
 """
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name):
+    """Lazy public API (importing the engine pulls in jax; keep bare
+    `import ollamamq_tpu` cheap for tooling)."""
+    if name == "TPUEngine":
+        from ollamamq_tpu.engine.engine import TPUEngine
+
+        return TPUEngine
+    if name == "FakeEngine":
+        from ollamamq_tpu.engine.fake import FakeEngine
+
+        return FakeEngine
+    if name == "Server":
+        from ollamamq_tpu.server.app import Server
+
+        return Server
+    if name == "EngineConfig":
+        from ollamamq_tpu.config import EngineConfig
+
+        return EngineConfig
+    if name == "MODEL_CONFIGS":
+        from ollamamq_tpu.config import MODEL_CONFIGS
+
+        return MODEL_CONFIGS
+    raise AttributeError(name)

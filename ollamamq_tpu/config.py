@@ -164,6 +164,9 @@ class EngineConfig:
     # Decode steps executed per host-loop iteration when no prefill pending
     # (amortizes dispatch overhead via lax.scan).
     decode_steps_per_iter: int = 8
+    # Repeat-penalty window: how many recent context tokens are penalized
+    # (llama.cpp repeat_last_n; engine-wide static).
+    repeat_last_n: int = 64
     # Mesh axis sizes; tp=-1 means "all remaining devices". The engine
     # builds its (data, seq, tensor) mesh from these unless an explicit
     # mesh object is passed to TPUEngine.

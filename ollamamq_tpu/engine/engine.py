@@ -45,7 +45,7 @@ from ollamamq_tpu.engine import kv_cache as kvc
 from ollamamq_tpu.engine.request import FinishReason, Request, StreamItem
 from ollamamq_tpu.engine.tokenizer import load_tokenizer
 from ollamamq_tpu.models import llama, weights
-from ollamamq_tpu.ops.sampling import sample_tokens
+from ollamamq_tpu.ops.sampling import apply_repeat_penalty, sample_tokens
 from ollamamq_tpu.parallel.mesh import make_mesh, validate_tp_for_model
 from ollamamq_tpu.parallel.sharding import kv_cache_spec, shard_params
 
@@ -85,6 +85,12 @@ class ModelRuntime:
             kv_sharding = NamedSharding(mesh, kv_cache_spec())
         self.params = params
         self.kc, self.vc = kvc.alloc_kv_pool(model_cfg, engine_cfg, kv_sharding, dtype)
+        # Repeat-penalty state: ring of each slot's last-W context token ids
+        # (-1 = empty), llama.cpp repeat_last_n semantics. Row S is a trash
+        # row so padded/inactive scatter targets never touch a live slot.
+        self.recent = jnp.full(
+            (engine_cfg.max_slots + 1, engine_cfg.repeat_last_n), -1, jnp.int32
+        )
         self.alloc = kvc.PageAllocator(
             engine_cfg.num_pages, engine_cfg.page_size, engine_cfg.max_pages_per_seq
         )
@@ -101,6 +107,7 @@ class ModelRuntime:
         self.temp = np.zeros((S,), np.float32)
         self.top_k = np.zeros((S,), np.int32)
         self.top_p = np.ones((S,), np.float32)
+        self.rep_pen = np.ones((S,), np.float32)
 
         self.pending_prefill: collections.deque = collections.deque()
         # Long prompts mid-chunked-prefill (one chunk advanced per tick).
@@ -177,37 +184,58 @@ class ModelRuntime:
         return jax.random.PRNGKey(self._rng_counter)
 
     # -- dispatch seams (SPMD subclass broadcasts before dispatching) ------
-    def _dispatch_prefill(self, bucket, B, tokens, lens, pt_rows, temp, tk, tp, key):
+    # Each returns (sampled_tokens, kc', vc', recent'); the caller assigns
+    # the three state arrays back.
+    def _dispatch_prefill(self, bucket, B, tokens, lens, slot_ids, pt_rows,
+                          temp, tk, tp, pen, key):
         fn = self._get_prefill_jit(bucket, B)
         return fn(self.params, jnp.asarray(tokens), jnp.asarray(lens),
-                  self.kc, self.vc, jnp.asarray(pt_rows),
-                  jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp), key)
+                  self.kc, self.vc, self.recent, jnp.asarray(slot_ids),
+                  jnp.asarray(pt_rows), jnp.asarray(temp), jnp.asarray(tk),
+                  jnp.asarray(tp), jnp.asarray(pen), key)
 
-    def _dispatch_chunk(self, chunk, tokens, start, cl, pt_row, temp, tk, tp, key):
+    def _dispatch_chunk(self, chunk, tokens, start, cl, slot_id, is_final,
+                        pt_row, temp, tk, tp, pen, key):
         fn = self._get_chunk_jit(chunk)
         return fn(self.params, jnp.asarray(tokens), jnp.asarray(start),
-                  jnp.asarray(cl), self.kc, self.vc, jnp.asarray(pt_row),
-                  jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp), key)
+                  jnp.asarray(cl), self.kc, self.vc, self.recent,
+                  jnp.asarray(slot_id), jnp.asarray(is_final),
+                  jnp.asarray(pt_row), jnp.asarray(temp), jnp.asarray(tk),
+                  jnp.asarray(tp), jnp.asarray(pen), key)
 
-    def _dispatch_decode(self, k_steps, tokens, positions, pt, temp, tk, tp, key):
+    def _dispatch_decode(self, k_steps, tokens, positions, active, pt, temp,
+                         tk, tp, pen, key):
         fn = self._get_decode_jit(k_steps)
         return fn(self.params, jnp.asarray(tokens), jnp.asarray(positions),
-                  self.kc, self.vc, jnp.asarray(pt),
-                  jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp), key)
+                  self.kc, self.vc, self.recent, jnp.asarray(active),
+                  jnp.asarray(pt), jnp.asarray(temp), jnp.asarray(tk),
+                  jnp.asarray(tp), jnp.asarray(pen), key)
 
     def _get_prefill_jit(self, bucket: int, batch: int = 1):
         key_ = (bucket, batch)
         if key_ not in self._prefill_jits:
             cfg, ps = self.cfg, self.ecfg.page_size
 
-            def fn(params, tokens, seq_lens, kc, vc, pt, temp, tk, tp, key):
+            def fn(params, tokens, seq_lens, kc, vc, recent, slot_ids, pt,
+                   temp, tk, tp, pen, key):
                 logits, kc, vc = llama.forward_prefill(
                     params, cfg, tokens, seq_lens, kc, vc, pt, ps
                 )
-                tok = sample_tokens(logits, key, temp, tk, tp)
-                return tok, kc, vc
+                B, T = tokens.shape
+                W = recent.shape[1]
+                # Ring rows = the last W prompt tokens of each sequence.
+                idx = seq_lens[:, None] - W + jnp.arange(W)[None, :]  # [B,W]
+                gathered = jnp.take_along_axis(
+                    tokens, jnp.clip(idx, 0, T - 1), axis=1
+                )
+                rows = jnp.where(idx >= 0, gathered, -1)
+                pen_logits = apply_repeat_penalty(logits, rows, pen)
+                tok = sample_tokens(pen_logits, key, temp, tk, tp)
+                rows = jnp.concatenate([rows[:, 1:], tok[:, None]], axis=1)
+                recent = recent.at[slot_ids].set(rows)
+                return tok, kc, vc, recent
 
-            self._prefill_jits[key_] = jax.jit(fn, donate_argnums=(3, 4))
+            self._prefill_jits[key_] = jax.jit(fn, donate_argnums=(3, 4, 5))
         return self._prefill_jits[key_]
 
     def _get_chunk_jit(self, chunk: int):
@@ -217,14 +245,31 @@ class ModelRuntime:
         if ("chunk", chunk) not in self._prefill_jits:
             cfg, ps = self.cfg, self.ecfg.page_size
 
-            def fn(params, tokens, start, chunk_lens, kc, vc, pt, temp, tk, tp, key):
+            def fn(params, tokens, start, chunk_lens, kc, vc, recent, slot_id,
+                   is_final, pt, temp, tk, tp, pen, key):
                 logits, kc, vc = llama.forward_prefill_chunk(
                     params, cfg, tokens, start, chunk_lens, kc, vc, pt, ps
                 )
-                tok = sample_tokens(logits, key, temp, tk, tp)
-                return tok, kc, vc
+                C = tokens.shape[1]
+                W = recent.shape[1]
+                row = recent[slot_id[0]]  # [W]
+                row = jnp.where(start[0] == 0, jnp.full_like(row, -1), row)
+                # Slide the window: prev ++ this chunk's valid tokens, then
+                # keep the last W (dynamic shift by chunk_len).
+                chunk_toks = jnp.where(
+                    jnp.arange(C) < chunk_lens[0], tokens[0], -1
+                )
+                combined = jnp.concatenate([row, chunk_toks])  # [W+C]
+                row = jax.lax.dynamic_slice(combined, (chunk_lens[0],), (W,))
+                pen_logits = apply_repeat_penalty(logits, row[None], pen)
+                tok = sample_tokens(pen_logits, key, temp, tk, tp)
+                # Append the sampled token only on the final chunk.
+                row_f = jnp.concatenate([row[1:], tok])
+                row = jnp.where(is_final[0] > 0, row_f, row)
+                recent = recent.at[slot_id[0]].set(row)
+                return tok, kc, vc, recent
 
-            self._prefill_jits[("chunk", chunk)] = jax.jit(fn, donate_argnums=(4, 5))
+            self._prefill_jits[("chunk", chunk)] = jax.jit(fn, donate_argnums=(4, 5, 6))
         return self._prefill_jits[("chunk", chunk)]
 
     def _get_decode_jit(self, k_steps: int):
@@ -232,23 +277,36 @@ class ModelRuntime:
             cfg, ps = self.cfg, self.ecfg.page_size
             attn_impl = self.attn_impl
 
-            def fn(params, tokens, positions, kc, vc, pt, temp, tk, tp, key):
+            def fn(params, tokens, positions, kc, vc, recent, active, pt,
+                   temp, tk, tp, pen, key):
+                S = tokens.shape[0]
+
                 def step(carry, _):
-                    tokens, positions, kc, vc, key = carry
+                    tokens, positions, kc, vc, recent, key = carry
                     logits, kc, vc = llama.forward_decode(
                         params, cfg, tokens, positions, kc, vc, pt, ps,
                         attn_impl=attn_impl,
                     )
                     key, sub = jax.random.split(key)
-                    nxt = sample_tokens(logits, sub, temp, tk, tp)
-                    return (nxt, positions + 1, kc, vc, key), nxt
+                    pen_logits = apply_repeat_penalty(logits, recent[:S], pen)
+                    nxt = sample_tokens(pen_logits, sub, temp, tk, tp)
+                    # Roll the sampled token into ACTIVE slots' rings only —
+                    # reserved (mid-chunked-prefill) slots must not collect
+                    # garbage tokens.
+                    rolled = jnp.concatenate(
+                        [recent[:S, 1:], nxt[:, None]], axis=1
+                    )
+                    new_rows = jnp.where(active[:, None] > 0, rolled, recent[:S])
+                    recent = recent.at[:S].set(new_rows)
+                    return (nxt, positions + 1, kc, vc, recent, key), nxt
 
-                (tokens, positions, kc, vc, key), toks = jax.lax.scan(
-                    step, (tokens, positions, kc, vc, key), None, length=k_steps
+                (tokens, positions, kc, vc, recent, key), toks = jax.lax.scan(
+                    step, (tokens, positions, kc, vc, recent, key), None,
+                    length=k_steps,
                 )
-                return toks, kc, vc  # toks: [K, S]
+                return toks, kc, vc, recent  # toks: [K, S]
 
-            self._decode_jits[k_steps] = jax.jit(fn, donate_argnums=(3, 4))
+            self._decode_jits[k_steps] = jax.jit(fn, donate_argnums=(3, 4, 5))
         return self._decode_jits[k_steps]
 
     # -- slot lifecycle ----------------------------------------------------
@@ -265,6 +323,7 @@ class ModelRuntime:
         self.temp[slot] = 0.0
         self.top_k[slot] = 0
         self.top_p[slot] = 1.0
+        self.rep_pen[slot] = 1.0
         self.slot_req[slot] = None
         req.stats.completion_tokens = len(req.generated_ids)
         if reason == FinishReason.CANCELLED:
@@ -352,9 +411,14 @@ class ModelRuntime:
                 self.pending_prefill.popleft()
                 req.stats.prefill_started_at = time.monotonic()
                 self.slot_pages[slot] = pages
-                self.page_table[slot, :] = kvc.make_page_table_row(
+                # The row stays OFF the shared page table until the final
+                # chunk installs the slot: interleaved decode steps write
+                # every slot's position through self.page_table, and a
+                # reserved slot must keep pointing at the trash page or the
+                # chunk's KV would be stomped.
+                req._pt_row = kvc.make_page_table_row(
                     pages, self.ecfg.max_pages_per_seq
-                )
+                )[None, :]
                 # Incremental chunked prefill: ONE chunk per engine tick so
                 # concurrent decode streams keep flowing.
                 req._chunk_pos = 0
@@ -398,19 +462,25 @@ class ModelRuntime:
         temp = np.zeros((B,), np.float32)
         top_k = np.zeros((B,), np.int32)
         top_p = np.ones((B,), np.float32)
+        pen = np.ones((B,), np.float32)
+        # Padding rows target the trash ring-row (index max_slots), never a
+        # live slot.
+        slot_ids = np.full((B,), self.ecfg.max_slots, np.int32)
         for i, (req, slot, _, n) in enumerate(batch):
             tokens[i, :n] = req.prompt_tokens
             lens[i] = n
             temp[i] = req.sampling.temperature
             top_k[i] = req.sampling.top_k
             top_p[i] = req.sampling.top_p
+            pen[i] = req.sampling.repeat_penalty
+            slot_ids[i] = slot
             pt_rows[i] = self.page_table[slot]
         self.inflight_prefill = [req for req, *_ in batch]
         t0 = time.monotonic()
         try:
-            toks, self.kc, self.vc = self._dispatch_prefill(
-                bucket, B, tokens, lens, pt_rows, temp, top_k, top_p,
-                self._next_key(),
+            toks, self.kc, self.vc, self.recent = self._dispatch_prefill(
+                bucket, B, tokens, lens, slot_ids, pt_rows, temp, top_k,
+                top_p, pen, self._next_key(),
             )
             toks = np.asarray(toks)
         except Exception as e:
@@ -451,6 +521,7 @@ class ModelRuntime:
         self.temp[slot] = req.sampling.temperature
         self.top_k[slot] = req.sampling.top_k
         self.top_p[slot] = req.sampling.top_p
+        self.rep_pen[slot] = req.sampling.repeat_penalty
         self.tokens_generated += 1
         if self._emit_token(slot, tok, core):
             # Token written at position n during the next decode step.
@@ -482,13 +553,16 @@ class ModelRuntime:
         tokens = np.zeros((1, largest), np.int32)
         tokens[0, :cl] = piece
         t0 = time.monotonic()
-        tok, self.kc, self.vc = self._dispatch_chunk(
+        is_final = 1 if chunk_start + cl >= n else 0
+        tok, self.kc, self.vc, self.recent = self._dispatch_chunk(
             largest, tokens,
             np.asarray([chunk_start], np.int32), np.asarray([cl], np.int32),
-            self.page_table[slot : slot + 1],
+            np.asarray([slot], np.int32), np.asarray([is_final], np.int32),
+            req._pt_row,
             np.asarray([s.temperature], np.float32),
             np.asarray([s.top_k], np.int32),
             np.asarray([s.top_p], np.float32),
+            np.asarray([s.repeat_penalty], np.float32),
             self._next_key(),
         )
         self.prefill_latency_ms = (time.monotonic() - t0) * 1e3
@@ -496,9 +570,11 @@ class ModelRuntime:
         if req._chunk_pos < n:
             return True  # more chunks next tick
 
-        # Final chunk: install into the slot and emit the first token.
+        # Final chunk: publish the page-table row (decode may write through
+        # it from now on), install the slot, emit the first token.
         self.chunking.popleft()
         self.reserved_slots.discard(slot)
+        self.page_table[slot, :] = req._pt_row[0]
         self._install_slot(slot, req, n, int(np.asarray(tok)[0]), core)
         return True
 
@@ -522,11 +598,14 @@ class ModelRuntime:
             return 0
 
         t0 = time.monotonic()
-        toks, self.kc, self.vc = self._dispatch_decode(
+        active_mask = np.asarray(
+            [1 if r is not None else 0 for r in self.slot_req], np.int32
+        )
+        toks, self.kc, self.vc, self.recent = self._dispatch_decode(
             k_steps, self.last_tokens,
             self.seq_lens,  # position of the incoming token
-            self.page_table, self.temp, self.top_k, self.top_p,
-            self._next_key(),
+            active_mask, self.page_table, self.temp, self.top_k, self.top_p,
+            self.rep_pen, self._next_key(),
         )
         toks = np.asarray(toks)  # [K, S]
         self.step_latency_ms = (time.monotonic() - t0) * 1e3 / k_steps

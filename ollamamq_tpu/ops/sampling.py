@@ -22,6 +22,7 @@ class SamplingParams:
     temperature: float = 0.0  # 0 => greedy
     top_k: int = 0  # 0 => disabled
     top_p: float = 1.0
+    repeat_penalty: float = 1.0  # 1.0 => off (Ollama's default is 1.1)
     seed: int = 0
     max_tokens: int = 256
     stop: tuple = ()
@@ -33,6 +34,7 @@ class SamplingParams:
             temperature=float(options.get("temperature", 0.8) or 0.0),
             top_k=int(options.get("top_k", 0) or 0),
             top_p=float(options.get("top_p", 1.0) or 1.0),
+            repeat_penalty=float(options.get("repeat_penalty", 1.1) or 1.0),
             seed=int(options.get("seed", 0) or 0),
             max_tokens=int(options.get("num_predict", max_tokens_default) or max_tokens_default),
             stop=tuple(options.get("stop", []) or []),
@@ -53,6 +55,28 @@ class SamplingParams:
             ),
             stop=tuple(stop),
         )
+
+
+def recent_token_mask(recent: jnp.ndarray, vocab: int) -> jnp.ndarray:
+    """[B, W] ring of recent token ids (-1 = empty) -> [B, V] int8 mask."""
+    B, _ = recent.shape
+    valid = (recent >= 0).astype(jnp.int8)
+    mask = jnp.zeros((B, vocab), jnp.int8)
+    return mask.at[jnp.arange(B)[:, None], jnp.clip(recent, 0)].max(valid)
+
+
+def apply_repeat_penalty(
+    logits: jnp.ndarray,  # [B, V] float32
+    recent: jnp.ndarray,  # [B, W] int32 — last-W context token ids (-1 pad)
+    penalty: jnp.ndarray,  # [B] float (1.0 = off)
+) -> jnp.ndarray:
+    """llama.cpp-style repetition penalty over the recent-token window
+    (repeat_last_n semantics): for tokens in the window, positive logits
+    divide by the penalty and negative logits multiply by it."""
+    mask = recent_token_mask(recent, logits.shape[1])
+    p = penalty[:, None]
+    penalized = jnp.where(logits > 0, logits / p, logits * p)
+    return jnp.where((mask > 0) & (p != 1.0), penalized, logits)
 
 
 def sample_tokens(

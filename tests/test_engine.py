@@ -442,3 +442,33 @@ def test_kv_pool_pressure_waits_and_recovers():
         assert all(snap["users"][f"p{i}"]["processed"] == 1 for i in range(8))
     finally:
         eng.stop()
+
+
+def test_repeat_penalty_suppresses_repeats():
+    """With an extreme repeat_penalty, greedy decode never re-emits a token
+    already in the context (prompt or generated) — llama.cpp semantics."""
+    eng = TPUEngine(small_cfg(num_pages=128, max_pages_per_seq=16),
+                    blocklist_path=None)
+    eng.start()
+    try:
+        rt = eng.runtimes["test-tiny"]
+        rt.tokenizer.eos_id = -1
+        tok = rt.tokenizer
+        prompt = tok.encode("penalty check")
+        req = eng.enqueue_request(
+            "p", "", "test-tiny", prompt_tokens=prompt,
+            sampling=SamplingParams(max_tokens=20, repeat_penalty=1e6))
+        items = collect(req)
+        assert items[-1].kind == "done"
+        gen = req.generated_ids
+        assert len(gen) == len(set(gen)), f"repeated token in {gen}"
+        assert not (set(gen) & set(prompt)), "re-emitted a prompt token"
+
+        # Control: penalty off CAN repeat (greedy on random weights loops).
+        req2 = eng.enqueue_request(
+            "p2", "", "test-tiny", prompt_tokens=prompt,
+            sampling=SamplingParams(max_tokens=20, repeat_penalty=1.0))
+        collect(req2)
+        assert req2.generated_ids != gen
+    finally:
+        eng.stop()

@@ -226,3 +226,16 @@ def test_chunked_prefill_ragged_last_chunk(tiny_cfg, tiny_params):
     np.testing.assert_allclose(
         np.asarray(logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
     )
+
+
+def test_apply_repeat_penalty_math():
+    from ollamamq_tpu.ops.sampling import apply_repeat_penalty
+
+    logits = jnp.array([[2.0, -2.0, 1.0, -1.0]])
+    seen = jnp.array([[1, 1, 0, 0]], jnp.int8)
+    pen = jnp.array([2.0])
+    out = np.asarray(apply_repeat_penalty(logits, seen, pen))
+    np.testing.assert_allclose(out, [[1.0, -4.0, 1.0, -1.0]])
+    # penalty 1.0 => identity
+    out2 = np.asarray(apply_repeat_penalty(logits, seen, jnp.array([1.0])))
+    np.testing.assert_allclose(out2, np.asarray(logits))
