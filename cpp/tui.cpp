@@ -341,7 +341,10 @@ struct Tui {
         out.push_back(std::string(DIM) + l + RST);
         double pfms = m->get("prefill_latency_ms")
                           ? m->get("prefill_latency_ms")->as_num() : 0;
-        std::snprintf(l, sizeof l, "    last prefill %.1fms (TTFT path)", pfms);
+        double ttft50 = m->get("ttft_p50_ms") ? m->get("ttft_p50_ms")->as_num() : 0;
+        double st50 = m->get("step_p50_ms") ? m->get("step_p50_ms")->as_num() : 0;
+        std::snprintf(l, sizeof l, "    last prefill %.1fms  TTFT p50 %.0fms  step p50 %.1fms",
+                      pfms, ttft50, st50);
         out.push_back(std::string(DIM) + l + RST);
       }
       ++idx;

@@ -781,6 +781,7 @@ class TPUEngine:
         self.pending: Dict[int, Request] = {}
         self._orphans: List[tuple] = []
         self._expired_orphans: Dict[int, float] = {}
+        self._last_stuck_log = 0.0
         self._pending_lock = threading.Lock()
         self._cond = threading.Condition()
         self._running = False
@@ -984,7 +985,18 @@ class TPUEngine:
             try:
                 item = self.core.next(eligible_models=eligible)
             except StuckQueue:
-                break  # policy pick unservable; cursor advanced, retry on wake
+                # Policy pick unservable; cursor advanced, retry on wake.
+                # Rate-limited warn for operator visibility (the reference
+                # logs "Request stuck in queue", dispatcher.rs:467-473).
+                now = time.monotonic()
+                if now - self._last_stuck_log > 10.0:
+                    self._last_stuck_log = now
+                    log.warning(
+                        "request stuck in queue: scheduler pick needs a model "
+                        "not currently servable (loaded: %s; %d queued)",
+                        eligible, self.core.total_queued(),
+                    )
+                break
             if item is None:
                 break
             rid, user, model = item

@@ -217,9 +217,13 @@ class Server:
     async def root(self, request: web.Request) -> web.Response:
         # Ollama answers its root with this exact liveness string; clients
         # (and the reference's health fallback, dispatcher.rs:363-371) use it.
+        # Block check applies: the reference routes "/" through its proxy
+        # handler, so blocked users 403 everywhere except /health.
+        self._ident(request)
         return web.Response(text="Ollama is running")
 
     async def metrics(self, request: web.Request) -> web.Response:
+        self._ident(request)
         return web.json_response(self.engine.stats())
 
     async def debug_profile(self, request: web.Request) -> web.Response:
@@ -233,6 +237,7 @@ class Server:
         """
         import os
 
+        self._ident(request)
         body = await self._body_json(request)
         try:
             seconds = max(0.1, min(float(body.get("seconds", 3.0)), 30.0))
@@ -498,6 +503,7 @@ class Server:
         raise ApiError(501, "blob upload is not supported on the TPU registry")
 
     async def api_version(self, request: web.Request) -> web.Response:
+        self._ident(request)
         return web.json_response({"version": __version__})
 
     # --------------------------------------------------------------- /v1/*

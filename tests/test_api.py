@@ -316,3 +316,22 @@ async def test_user_id_header_tracked(client):
     r = await client.get("/metrics")
     stats = await r.json()
     assert stats["queue"]["users"]["alice"]["processed"] == 1
+
+
+@api_test
+async def test_blocked_user_403_on_all_proxied_routes(client):
+    """The reference routes '/', /api/version etc. through the blocked
+    check (every proxy_handler route 403s); only /health is exempt."""
+    client.engine.core.block_user("banned")
+    hdr = {"X-User-ID": "banned"}
+    for path in ("/", "/api/version", "/api/tags", "/v1/models", "/metrics"):
+        r = await client.get(path, headers=hdr)
+        assert r.status == 403, path
+    r = await client.get("/health", headers=hdr)
+    assert r.status == 200  # liveness stays open, like the reference
+
+
+@api_test
+async def test_debug_profile_validation(client):
+    r = await client.post("/debug/profile", json={"seconds": "abc"})
+    assert r.status == 400
