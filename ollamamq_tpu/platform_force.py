@@ -1,0 +1,53 @@
+"""Force JAX onto the CPU platform with N virtual devices.
+
+The deployment environment may export a TPU platform (e.g. JAX_PLATFORMS=axon
+with a sitecustomize that registers a PJRT plugin in every process); tests and
+the driver's multichip dry run must win over that without touching hardware.
+
+This module must stay importable before jax is initialized — it imports jax
+itself only inside force_cpu().
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_FLAG = "xla_force_host_platform_device_count"
+
+
+def force_cpu(n_devices: int) -> None:
+    """Pin JAX to CPU with at least ``n_devices`` virtual devices.
+
+    Call before any jax device/backend touch. Sets the env vars (honoring a
+    pre-existing --xla_force_host_platform_device_count only if it is already
+    large enough — a stale smaller value is replaced) and jax.config, which
+    wins even when a sitecustomize pre-registered a TPU plugin.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(rf"--{_FLAG}=(\d+)", flags)
+    if m is None:
+        flags = (flags + f" --{_FLAG}={n_devices}").strip()
+    elif int(m.group(1)) < n_devices:
+        flags = re.sub(rf"--{_FLAG}=\d+", f"--{_FLAG}={n_devices}", flags)
+    os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    # jax caches backends on first touch; if something initialized the real
+    # TPU platform before us, the env/config changes above are silently
+    # ignored — fail loudly instead of running "multi-chip CPU" work on it.
+    plat = jax.devices()[0].platform
+    if plat != "cpu":
+        raise RuntimeError(
+            f"force_cpu() called after jax initialized platform {plat!r}; "
+            "call it before any jax device/backend touch"
+        )
+    n = len(jax.devices())
+    if n < n_devices:
+        raise RuntimeError(
+            f"force_cpu({n_devices}) got only {n} CPU devices; XLA_FLAGS "
+            f"({os.environ['XLA_FLAGS']!r}) was read before this call?"
+        )

@@ -1,19 +1,9 @@
 """Test config: force JAX onto CPU with 8 virtual devices BEFORE jax import,
 so mesh/sharding logic is exercised without a TPU (SURVEY.md §4)."""
 
-import os
+from ollamamq_tpu.platform_force import force_cpu
 
-# Force CPU even if the shell exports a TPU platform (e.g. JAX_PLATFORMS=axon).
-# A sitecustomize may already have imported jax and registered a TPU plugin,
-# so setting the env var alone is not enough — use jax.config as well.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+force_cpu(8)
 
 import pytest  # noqa: E402
 
