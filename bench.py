@@ -15,9 +15,25 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import statistics
 import sys
+import threading
 import time
+
+
+def _emit_error(msg: str, **extras) -> None:
+    """Structured failure line: same shape as the success line so the
+    driver's JSON parse always gets a record (round 1 produced nothing
+    when TPU backend init died — VERDICT.md 'What's weak' #1)."""
+    print(json.dumps({
+        "metric": "decode_tok_per_s_per_chip",
+        "value": 0.0,
+        "unit": "tok/s/chip",
+        "vs_baseline": 0.0,
+        "error": msg,
+        **extras,
+    }), flush=True)
 
 
 def main() -> int:
@@ -29,26 +45,64 @@ def main() -> int:
     p.add_argument("--chunk", type=int, default=16, help="decode steps per dispatch")
     p.add_argument("--warmup-steps", type=int, default=32)
     p.add_argument("--ttft-samples", type=int, default=8)
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU platform (smoke-testing the harness)")
+    p.add_argument("--init-timeout", type=float, default=300.0,
+                   help="seconds to wait for device/backend init before "
+                        "emitting a structured error and exiting")
     args = p.parse_args()
+
+    # Everything that can fail on operator error must fail BEFORE the first
+    # device touch: a wedged TPU tunnel makes jax.devices() hang, and an
+    # argument typo must not spend (or wedge) the one chip claim.
+    if min(args.slots, args.prompt_len, args.steps, args.chunk,
+           args.ttft_samples) < 1 or args.warmup_steps < 0:
+        _emit_error("invalid arguments: counts must be positive")
+        return 2
+
+    from ollamamq_tpu.config import MODEL_CONFIGS, EngineConfig, get_model_config
+
+    model_cfg = get_model_config(args.model)
+    if model_cfg is None:
+        _emit_error(f"unknown model '{args.model}'", known=sorted(MODEL_CONFIGS))
+        return 2
+
+    if args.cpu:
+        from ollamamq_tpu.platform_force import force_cpu
+
+        force_cpu(1)
 
     import jax
 
     import numpy as np
 
-    from ollamamq_tpu.config import MODEL_CONFIGS, EngineConfig
     from ollamamq_tpu.engine.engine import ModelRuntime
     from ollamamq_tpu.engine.request import Request
     from ollamamq_tpu.core import MQCore
     from ollamamq_tpu.ops.sampling import SamplingParams
 
-    from ollamamq_tpu.config import get_model_config
+    # Backend init can hang forever on a wedged tunnel (jax.devices() blocks
+    # in make_c_api_client), and so can the weight upload inside
+    # ModelRuntime init. A daemon watchdog spanning both phases turns a hang
+    # into a structured error line instead of a silent driver timeout.
+    # --init-timeout <= 0 disables the watchdog.
+    init_done = threading.Event()
 
-    model_cfg = get_model_config(args.model)
-    if model_cfg is None:
-        print(json.dumps({"error": f"unknown model '{args.model}'",
-                          "known": sorted(MODEL_CONFIGS)}))
-        return 2
-    dev = jax.devices()[0]
+    def _watchdog():
+        if not init_done.wait(args.init_timeout):
+            _emit_error(
+                f"device/runtime init exceeded {args.init_timeout:.0f}s "
+                "(wedged TPU tunnel?)", phase="init")
+            os._exit(3)
+
+    if args.init_timeout > 0:
+        threading.Thread(target=_watchdog, daemon=True).start()
+    try:
+        dev = jax.devices()[0]
+    except Exception as e:
+        init_done.set()
+        _emit_error(f"backend init failed: {type(e).__name__}: {e}", phase="init")
+        return 3
     # Pages: prompt + generated headroom for every slot.
     tokens_per_seq = args.prompt_len + args.steps + args.chunk
     page_size = 16
@@ -65,7 +119,14 @@ def main() -> int:
     )
     core = MQCore(None)
     t0 = time.monotonic()
-    rt = ModelRuntime(args.model, model_cfg, ecfg)
+    try:
+        rt = ModelRuntime(args.model, model_cfg, ecfg)
+    except Exception as e:
+        _emit_error(f"runtime init failed: {type(e).__name__}: {e}",
+                    phase="runtime_init", device=str(dev))
+        return 4
+    finally:
+        init_done.set()  # watchdog covers device + runtime init, not the run
     init_s = time.monotonic() - t0
 
     rng = np.random.default_rng(0)
@@ -103,12 +164,14 @@ def main() -> int:
     # Warmup (compiles the decode chunk). If the Pallas kernel fails to
     # compile on this hardware, fall back to the jnp attention path rather
     # than losing the benchmark run.
+    attn_fallback = False
     try:
         rt.step_decode(core, k_steps=args.chunk)
     except Exception as e:
         if rt.attn_impl == "pallas":
             print(f"# pallas path failed ({type(e).__name__}); falling back to jnp",
                   file=sys.stderr)
+            attn_fallback = True
             rt.attn_impl = "jnp"
             rt._decode_jits.clear()
             rt.step_decode(core, k_steps=args.chunk)
@@ -147,8 +210,9 @@ def main() -> int:
         "ttft_compile_ms": round(ttft_compile_ms, 1),
         "init_s": round(init_s, 1),
         "attn_impl": rt.attn_impl,
+        "attn_fallback": attn_fallback,
     }
-    print(json.dumps(result))
+    print(json.dumps(result), flush=True)
     return 0
 
 

@@ -127,6 +127,10 @@ struct mq_state {
   size_t rr_cursor = 0;  // persistent across rounds (dispatcher.rs run_worker local)
   int64_t next_req_id = 1;
   int fairness_mode = MQ_FAIR_REQUESTS;
+  // Bumped on every block mutation (user or IP, from any caller incl. the
+  // native TUI thread); lets the engine's late blocked re-check sweep held
+  // requests only when the blocklist actually changed.
+  int64_t block_version = 0;
   std::string blocklist_path;
 
   void save_blocklist_locked() {
@@ -292,6 +296,7 @@ void mq_mark_dropped(mq_state *s, const char *user, int was_started) {
 void mq_block_user(mq_state *s, const char *user) {
   std::lock_guard<std::mutex> g(s->mu);
   s->blocked_users.insert(user);
+  s->block_version += 1;
   s->save_blocklist_locked();
 }
 
@@ -304,6 +309,7 @@ void mq_unblock_user(mq_state *s, const char *user) {
 void mq_block_ip(mq_state *s, const char *ip) {
   std::lock_guard<std::mutex> g(s->mu);
   s->blocked_ips.insert(ip);
+  s->block_version += 1;
   s->save_blocklist_locked();
 }
 
@@ -321,6 +327,21 @@ int mq_is_user_blocked(mq_state *s, const char *user) {
 int mq_is_ip_blocked(mq_state *s, const char *ip) {
   std::lock_guard<std::mutex> g(s->mu);
   return s->blocked_ips.count(ip) ? 1 : 0;
+}
+
+int64_t mq_block_version(mq_state *s) {
+  std::lock_guard<std::mutex> g(s->mu);
+  return s->block_version;
+}
+
+int mq_is_user_or_ip_blocked(mq_state *s, const char *user) {
+  // One lock + one FFI round trip for the late re-check: blocked directly,
+  // or via the last IP this user was seen from (dispatcher.rs:503-512
+  // re-checks both sets).
+  std::lock_guard<std::mutex> g(s->mu);
+  if (s->blocked_users.count(user)) return 1;
+  auto it = s->user_ips.find(user);
+  return (it != s->user_ips.end() && s->blocked_ips.count(it->second)) ? 1 : 0;
 }
 
 int mq_unblock_item(mq_state *s, const char *item) {

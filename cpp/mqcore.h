@@ -84,6 +84,12 @@ int mq_is_user_blocked(mq_state *, const char *user);
 int mq_is_ip_blocked(mq_state *, const char *ip);
 /* Unblock by either kind (tui 'u' key); returns 1 if anything removed. */
 int mq_unblock_item(mq_state *, const char *item);
+/* Monotonic counter bumped by every block mutation; the engine's late
+ * re-check sweeps held requests only when this changes. */
+int64_t mq_block_version(mq_state *);
+/* Blocked directly, or via the user's last recorded IP (the reference's
+ * dispatch-time re-check covers both sets, dispatcher.rs:503-512). */
+int mq_is_user_or_ip_blocked(mq_state *, const char *user);
 
 /* VIP/boost: set to user or clear with NULL. Toggle semantics (same user
  * clears the other slot) are the caller's job, mirroring the TUI. */

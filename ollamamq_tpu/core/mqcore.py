@@ -80,6 +80,10 @@ def _load() -> ctypes.CDLL:
     lib.mq_is_ip_blocked.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.mq_unblock_item.restype = ctypes.c_int
     lib.mq_unblock_item.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.mq_block_version.restype = ctypes.c_int64
+    lib.mq_block_version.argtypes = [ctypes.c_void_p]
+    lib.mq_is_user_or_ip_blocked.restype = ctypes.c_int
+    lib.mq_is_user_or_ip_blocked.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.mq_set_fairness_mode.restype = None
     lib.mq_set_fairness_mode.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.mq_queue_len.restype = ctypes.c_int64
@@ -194,6 +198,13 @@ class MQCore:
 
     def is_user_blocked(self, user: str) -> bool:
         return bool(self._lib.mq_is_user_blocked(self._h, user.encode()))
+
+    def block_version(self) -> int:
+        return int(self._lib.mq_block_version(self._h))
+
+    def is_user_or_ip_blocked(self, user: str) -> bool:
+        """Blocked directly or via the user's last recorded IP."""
+        return bool(self._lib.mq_is_user_or_ip_blocked(self._h, user.encode()))
 
     def is_ip_blocked(self, ip: str) -> bool:
         return bool(self._lib.mq_is_ip_blocked(self._h, ip.encode()))

@@ -52,6 +52,24 @@ from ollamamq_tpu.parallel.sharding import kv_cache_spec, shard_params
 log = logging.getLogger("ollamamq.engine")
 
 
+def sweep_blocked(core: MQCore, held_fn, last_version: int) -> int:
+    """Cancel held requests of blocked users; returns the blocklist version
+    the sweep ran against. No-op (zero FFI calls beyond the version read)
+    unless the blocklist changed since `last_version` — blocks are rare,
+    ticks are not. Starting runtimes at version -1 makes the first tick
+    sweep once, covering blocklist entries loaded from disk at startup."""
+    ver = core.block_version()
+    if ver == last_version:
+        return ver
+    held = held_fn()
+    users = {r.user for r in held if not r.cancelled.is_set()}
+    blocked = {u for u in users if core.is_user_or_ip_blocked(u)}
+    for req in held:
+        if req.user in blocked:
+            req.cancelled.set()
+    return ver
+
+
 class ModelRuntime:
     """Per-model decode state: KV pool, slot table, compiled step fns."""
 
@@ -110,6 +128,7 @@ class ModelRuntime:
         self.rep_pen = np.ones((S,), np.float32)
 
         self.pending_prefill: collections.deque = collections.deque()
+        self._block_ver = -1  # force one startup sweep (disk-loaded blocklist)
         # Long prompts mid-chunked-prefill (one chunk advanced per tick).
         self.chunking: collections.deque = collections.deque()
         # Requests inside a prefill forward right now (cancel() must still
@@ -625,9 +644,25 @@ class ModelRuntime:
         return emitted
 
     def check_cancellations(self, core: MQCore) -> None:
+        """Reap cancelled requests and requests whose user was blocked after
+        admission. The reference re-checks the blocklist at dispatch time
+        (dispatcher.rs:503-512); with continuous batching a request is
+        'dispatched' for its whole lifetime, so the late re-check covers the
+        slots and prefill queues — version-gated so the hot loop pays no FFI
+        cost unless the blocklist actually changed. Blocked ⇒ cancel: the
+        existing cancel paths (slot finish, chunked-prefill abort,
+        pending-prefill pop) do the page reclaim and dropped accounting."""
+        self._block_ver = sweep_blocked(core, self._held_requests, self._block_ver)
         for i, req in enumerate(self.slot_req):
             if req is not None and req.cancelled.is_set():
                 self._finish_slot(i, FinishReason.CANCELLED, core)
+
+    def _held_requests(self):
+        return (
+            [r for r in self.slot_req if r is not None]
+            + list(self.pending_prefill)
+            + list(self.chunking)
+        )
 
     def stats(self) -> dict:
         def pctl(window, q):
@@ -670,6 +705,7 @@ class EncoderRuntime:
             params = shard_params(params, mesh)
         self.params = params
         self.pending: collections.deque = collections.deque()
+        self._block_ver = -1  # force one startup sweep (disk-loaded blocklist)
         self._jits: Dict[Tuple[int, int], callable] = {}
         self.param_bytes = sum(
             x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(params)
@@ -691,7 +727,9 @@ class EncoderRuntime:
         self.pending.append(req)
 
     def check_cancellations(self, core: MQCore) -> None:
-        pass
+        # Late blocked re-check (see ModelRuntime.check_cancellations).
+        self._block_ver = sweep_blocked(core, lambda: self.pending,
+                                        self._block_ver)
 
     def _get_jit(self, batch: int, bucket: int):
         key = (batch, bucket)
@@ -1012,7 +1050,9 @@ class TPUEngine:
         return admitted
 
     def _place(self, req: Request, user: str, model: str) -> bool:
-        if req.cancelled.is_set():  # late re-check (dispatcher.rs:503-512)
+        # Late re-check (dispatcher.rs:503-512): client gone OR user/IP
+        # blocked after enqueueing ⇒ drop, never serve.
+        if req.cancelled.is_set() or self.core.is_user_or_ip_blocked(user):
             self.core.mark_dropped(user, started=False)
             req.finish(FinishReason.CANCELLED)
             return False

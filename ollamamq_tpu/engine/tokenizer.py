@@ -34,25 +34,20 @@ class ByteTokenizer:
         return data.decode("utf-8", errors="replace")
 
     def make_incremental_decoder(self):
-        buf = bytearray()
+        # Incomplete multibyte tails are held back; invalid bytes (e.g. a
+        # bare continuation byte that could never complete) become U+FFFD
+        # immediately rather than wedging the buffer and silencing the
+        # stream for the rest of the generation.
+        import codecs
+
+        dec = codecs.getincrementaldecoder("utf-8")(errors="replace")
 
         def step(token_id: int) -> str:
             # Ids outside the byte range (possible with random-weight models
             # whose vocab exceeds 259) decode to nothing.
             if token_id < 3 or token_id >= 259:
                 return ""
-            buf.append(token_id - 3)
-            # Emit the longest prefix that is complete UTF-8.
-            for cut in range(len(buf), max(len(buf) - 4, -1), -1):
-                try:
-                    text = buf[:cut].decode("utf-8")
-                except UnicodeDecodeError:
-                    continue
-                if cut:
-                    del buf[:cut]
-                    return text
-                break
-            return ""
+            return dec.decode(bytes([token_id - 3]))
 
         return step
 

@@ -125,6 +125,52 @@ def test_cancellation_reclaims_pages():
         eng.stop()
 
 
+def test_late_block_drops_queued_and_midgen():
+    """Blocking a user AFTER their requests are enqueued drops every one of
+    them — the mid-generation slot and the queued request — with pages
+    reclaimed and dropped counted (reference late re-check,
+    dispatcher.rs:503-512)."""
+    eng = TPUEngine(
+        small_cfg(max_slots=1, num_pages=512, max_pages_per_seq=128,
+                  decode_steps_per_iter=1),
+        blocklist_path=None,
+    )
+    eng.start()
+    try:
+        rt = eng.runtimes["test-tiny"]
+        rt.tokenizer.eos_id = -1  # keep the mid-gen sequence running
+        free_before = rt.alloc.free_pages
+        tok = rt.tokenizer
+        rid1 = eng.core.enqueue("mallory", "", "test-tiny")
+        r1 = Request(rid1, "mallory", "test-tiny", tok.encode("one"),
+                     SamplingParams(max_tokens=10_000))
+        eng.submit(r1)
+        deadline = time.monotonic() + 60
+        while not r1.stats.first_token_at and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert r1.stats.first_token_at, "never started generating"
+        # Second request queues behind the single busy slot.
+        rid2 = eng.core.enqueue("mallory", "", "test-tiny")
+        r2 = Request(rid2, "mallory", "test-tiny", tok.encode("two"),
+                     SamplingParams(max_tokens=10_000))
+        eng.submit(r2)
+        eng.core.block_user("mallory")
+        eng.notify()
+        i1 = collect(r1)
+        i2 = collect(r2)
+        assert i1[-1].finish_reason == FinishReason.CANCELLED
+        assert i2[-1].finish_reason == FinishReason.CANCELLED
+        deadline = time.monotonic() + 10
+        while rt.alloc.free_pages < free_before and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert rt.alloc.free_pages == free_before  # KV pages reclaimed
+        snap = eng.core.snapshot()
+        assert snap["users"]["mallory"]["dropped"] >= 2
+        assert snap["users"]["mallory"]["queued"] == 0
+    finally:
+        eng.stop()
+
+
 def test_cancel_while_queued(engine):
     """Cancel before admission: dropped, never prefilled (late re-check)."""
     tok = engine.runtimes["test-tiny"].tokenizer

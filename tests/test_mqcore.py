@@ -150,6 +150,25 @@ def test_blocklist_and_403(core):
     core.enqueue("ok-user", ip="5.6.7.8")  # fine
 
 
+def test_block_version_and_combined_check(core):
+    """block_version bumps on every block mutation (the engine's late
+    re-check sweep gate); is_user_or_ip_blocked covers both sets via the
+    user's last recorded IP (dispatcher.rs:503-512)."""
+    v0 = core.block_version()
+    core.enqueue("ipuser", ip="6.6.6.6")
+    assert not core.is_user_or_ip_blocked("ipuser")
+    core.block_ip("6.6.6.6")
+    assert core.block_version() == v0 + 1
+    assert core.is_user_or_ip_blocked("ipuser")  # via IP
+    assert not core.is_user_blocked("ipuser")
+    core.block_user("directuser")
+    assert core.block_version() == v0 + 2
+    assert core.is_user_or_ip_blocked("directuser")
+    core.unblock_ip("6.6.6.6")
+    core.unblock_user("directuser")
+    assert not core.is_user_or_ip_blocked("ipuser")
+
+
 def test_blocklist_persistence(tmp_path):
     """blocked_items.json round-trip, reference-compatible schema
     (dispatcher.rs:19-25,165-182)."""

@@ -1,0 +1,36 @@
+"""ByteTokenizer: roundtrip and incremental streaming decode semantics."""
+
+from ollamamq_tpu.engine.tokenizer import ByteTokenizer
+
+
+def test_roundtrip():
+    tok = ByteTokenizer()
+    s = "héllo wörld ☃"
+    assert tok.decode(tok.encode(s, add_bos=False)) == s
+
+
+def test_incremental_holds_multibyte_tail():
+    tok = ByteTokenizer()
+    step = tok.make_incremental_decoder()
+    ids = tok.encode("☃", add_bos=False)  # 3-byte UTF-8 snowman
+    assert step(ids[0]) == ""
+    assert step(ids[1]) == ""
+    assert step(ids[2]) == "☃"
+
+
+def test_incremental_invalid_byte_does_not_wedge():
+    """A bare continuation byte can never complete a sequence; it must
+    surface as U+FFFD instead of silencing the rest of the stream."""
+    tok = ByteTokenizer()
+    step = tok.make_incremental_decoder()
+    assert step(0x80 + 3) == "�"  # invalid head byte
+    # Stream recovers: subsequent ASCII flows through immediately.
+    assert step(ord("a") + 3) == "a"
+
+
+def test_incremental_out_of_range_ids_silent():
+    tok = ByteTokenizer()
+    step = tok.make_incremental_decoder()
+    assert step(0) == "" and step(1) == "" and step(2) == ""
+    assert step(300) == ""  # beyond byte vocab (random-weight models)
+    assert step(ord("x") + 3) == "x"
