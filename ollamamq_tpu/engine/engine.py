@@ -45,7 +45,8 @@ from ollamamq_tpu.engine import kv_cache as kvc
 from ollamamq_tpu.engine.request import FinishReason, Request, StreamItem
 from ollamamq_tpu.engine.tokenizer import load_tokenizer
 from ollamamq_tpu.models import llama, weights
-from ollamamq_tpu.ops.sampling import apply_repeat_penalty, sample_tokens
+from ollamamq_tpu.ops.sampling import (apply_penalties, per_row_keys,
+                                       sample_tokens_rowwise)
 from ollamamq_tpu.parallel.mesh import make_mesh, validate_tp_for_model
 from ollamamq_tpu.parallel.sharding import kv_cache_spec, shard_params
 
@@ -126,6 +127,9 @@ class ModelRuntime:
         self.top_k = np.zeros((S,), np.int32)
         self.top_p = np.ones((S,), np.float32)
         self.rep_pen = np.ones((S,), np.float32)
+        self.pres_pen = np.zeros((S,), np.float32)
+        self.freq_pen = np.zeros((S,), np.float32)
+        self.seeds = np.zeros((S,), np.int32)  # >0 = per-request seed
 
         self.pending_prefill: collections.deque = collections.deque()
         self._block_ver = -1  # force one startup sweep (disk-loaded blocklist)
@@ -206,29 +210,32 @@ class ModelRuntime:
     # Each returns (sampled_tokens, kc', vc', recent'); the caller assigns
     # the three state arrays back.
     def _dispatch_prefill(self, bucket, B, tokens, lens, slot_ids, pt_rows,
-                          temp, tk, tp, pen, key):
+                          temp, tk, tp, pen, pres, freq, seeds, key):
         fn = self._get_prefill_jit(bucket, B)
         return fn(self.params, jnp.asarray(tokens), jnp.asarray(lens),
                   self.kc, self.vc, self.recent, jnp.asarray(slot_ids),
                   jnp.asarray(pt_rows), jnp.asarray(temp), jnp.asarray(tk),
-                  jnp.asarray(tp), jnp.asarray(pen), key)
+                  jnp.asarray(tp), jnp.asarray(pen), jnp.asarray(pres),
+                  jnp.asarray(freq), jnp.asarray(seeds), key)
 
     def _dispatch_chunk(self, chunk, tokens, start, cl, slot_id, is_final,
-                        pt_row, temp, tk, tp, pen, key):
+                        pt_row, temp, tk, tp, pen, pres, freq, seeds, key):
         fn = self._get_chunk_jit(chunk)
         return fn(self.params, jnp.asarray(tokens), jnp.asarray(start),
                   jnp.asarray(cl), self.kc, self.vc, self.recent,
                   jnp.asarray(slot_id), jnp.asarray(is_final),
                   jnp.asarray(pt_row), jnp.asarray(temp), jnp.asarray(tk),
-                  jnp.asarray(tp), jnp.asarray(pen), key)
+                  jnp.asarray(tp), jnp.asarray(pen), jnp.asarray(pres),
+                  jnp.asarray(freq), jnp.asarray(seeds), key)
 
     def _dispatch_decode(self, k_steps, tokens, positions, active, pt, temp,
-                         tk, tp, pen, key):
+                         tk, tp, pen, pres, freq, seeds, key):
         fn = self._get_decode_jit(k_steps)
         return fn(self.params, jnp.asarray(tokens), jnp.asarray(positions),
                   self.kc, self.vc, self.recent, jnp.asarray(active),
                   jnp.asarray(pt), jnp.asarray(temp), jnp.asarray(tk),
-                  jnp.asarray(tp), jnp.asarray(pen), key)
+                  jnp.asarray(tp), jnp.asarray(pen), jnp.asarray(pres),
+                  jnp.asarray(freq), jnp.asarray(seeds), key)
 
     def _get_prefill_jit(self, bucket: int, batch: int = 1):
         key_ = (bucket, batch)
@@ -236,7 +243,7 @@ class ModelRuntime:
             cfg, ps = self.cfg, self.ecfg.page_size
 
             def fn(params, tokens, seq_lens, kc, vc, recent, slot_ids, pt,
-                   temp, tk, tp, pen, key):
+                   temp, tk, tp, pen, pres, freq, seeds, key):
                 logits, kc, vc = llama.forward_prefill(
                     params, cfg, tokens, seq_lens, kc, vc, pt, ps
                 )
@@ -248,8 +255,9 @@ class ModelRuntime:
                     tokens, jnp.clip(idx, 0, T - 1), axis=1
                 )
                 rows = jnp.where(idx >= 0, gathered, -1)
-                pen_logits = apply_repeat_penalty(logits, rows, pen)
-                tok = sample_tokens(pen_logits, key, temp, tk, tp)
+                pen_logits = apply_penalties(logits, rows, pen, pres, freq)
+                row_keys = per_row_keys(key, seeds, seq_lens)
+                tok = sample_tokens_rowwise(pen_logits, row_keys, temp, tk, tp)
                 rows = jnp.concatenate([rows[:, 1:], tok[:, None]], axis=1)
                 recent = recent.at[slot_ids].set(rows)
                 return tok, kc, vc, recent
@@ -265,7 +273,7 @@ class ModelRuntime:
             cfg, ps = self.cfg, self.ecfg.page_size
 
             def fn(params, tokens, start, chunk_lens, kc, vc, recent, slot_id,
-                   is_final, pt, temp, tk, tp, pen, key):
+                   is_final, pt, temp, tk, tp, pen, pres, freq, seeds, key):
                 logits, kc, vc = llama.forward_prefill_chunk(
                     params, cfg, tokens, start, chunk_lens, kc, vc, pt, ps
                 )
@@ -280,8 +288,9 @@ class ModelRuntime:
                 )
                 combined = jnp.concatenate([row, chunk_toks])  # [W+C]
                 row = jax.lax.dynamic_slice(combined, (chunk_lens[0],), (W,))
-                pen_logits = apply_repeat_penalty(logits, row[None], pen)
-                tok = sample_tokens(pen_logits, key, temp, tk, tp)
+                pen_logits = apply_penalties(logits, row[None], pen, pres, freq)
+                row_keys = per_row_keys(key, seeds, start + chunk_lens)
+                tok = sample_tokens_rowwise(pen_logits, row_keys, temp, tk, tp)
                 # Append the sampled token only on the final chunk.
                 row_f = jnp.concatenate([row[1:], tok])
                 row = jnp.where(is_final[0] > 0, row_f, row)
@@ -297,7 +306,7 @@ class ModelRuntime:
             attn_impl = self.attn_impl
 
             def fn(params, tokens, positions, kc, vc, recent, active, pt,
-                   temp, tk, tp, pen, key):
+                   temp, tk, tp, pen, pres, freq, seeds, key):
                 S = tokens.shape[0]
 
                 def step(carry, _):
@@ -307,8 +316,16 @@ class ModelRuntime:
                         attn_impl=attn_impl,
                     )
                     key, sub = jax.random.split(key)
-                    pen_logits = apply_repeat_penalty(logits, recent[:S], pen)
-                    nxt = sample_tokens(pen_logits, sub, temp, tk, tp)
+                    pen_logits = apply_penalties(logits, recent[:S], pen,
+                                                 pres, freq)
+                    # Seeded streams fold in the position of the token being
+                    # SAMPLED (positions holds the incoming token's slot):
+                    # prefill folded n for the token at n, so the first
+                    # decode step must fold n+1, not n, or the two
+                    # consecutive sampling decisions share a key.
+                    row_keys = per_row_keys(sub, seeds, positions + 1)
+                    nxt = sample_tokens_rowwise(pen_logits, row_keys, temp,
+                                                tk, tp)
                     # Roll the sampled token into ACTIVE slots' rings only —
                     # reserved (mid-chunked-prefill) slots must not collect
                     # garbage tokens.
@@ -343,6 +360,9 @@ class ModelRuntime:
         self.top_k[slot] = 0
         self.top_p[slot] = 1.0
         self.rep_pen[slot] = 1.0
+        self.pres_pen[slot] = 0.0
+        self.freq_pen[slot] = 0.0
+        self.seeds[slot] = 0
         self.slot_req[slot] = None
         req.stats.completion_tokens = len(req.generated_ids)
         if reason == FinishReason.CANCELLED:
@@ -482,6 +502,9 @@ class ModelRuntime:
         top_k = np.zeros((B,), np.int32)
         top_p = np.ones((B,), np.float32)
         pen = np.ones((B,), np.float32)
+        pres = np.zeros((B,), np.float32)
+        freq = np.zeros((B,), np.float32)
+        seeds = np.zeros((B,), np.int32)
         # Padding rows target the trash ring-row (index max_slots), never a
         # live slot.
         slot_ids = np.full((B,), self.ecfg.max_slots, np.int32)
@@ -492,6 +515,9 @@ class ModelRuntime:
             top_k[i] = req.sampling.top_k
             top_p[i] = req.sampling.top_p
             pen[i] = req.sampling.repeat_penalty
+            pres[i] = req.sampling.presence_penalty
+            freq[i] = req.sampling.frequency_penalty
+            seeds[i] = req.sampling.seed
             slot_ids[i] = slot
             pt_rows[i] = self.page_table[slot]
         self.inflight_prefill = [req for req, *_ in batch]
@@ -499,7 +525,7 @@ class ModelRuntime:
         try:
             toks, self.kc, self.vc, self.recent = self._dispatch_prefill(
                 bucket, B, tokens, lens, slot_ids, pt_rows, temp, top_k,
-                top_p, pen, self._next_key(),
+                top_p, pen, pres, freq, seeds, self._next_key(),
             )
             toks = np.asarray(toks)
         except Exception as e:
@@ -541,6 +567,9 @@ class ModelRuntime:
         self.top_k[slot] = req.sampling.top_k
         self.top_p[slot] = req.sampling.top_p
         self.rep_pen[slot] = req.sampling.repeat_penalty
+        self.pres_pen[slot] = req.sampling.presence_penalty
+        self.freq_pen[slot] = req.sampling.frequency_penalty
+        self.seeds[slot] = req.sampling.seed
         self.tokens_generated += 1
         if self._emit_token(slot, tok, core):
             # Token written at position n during the next decode step.
@@ -582,6 +611,9 @@ class ModelRuntime:
             np.asarray([s.top_k], np.int32),
             np.asarray([s.top_p], np.float32),
             np.asarray([s.repeat_penalty], np.float32),
+            np.asarray([s.presence_penalty], np.float32),
+            np.asarray([s.frequency_penalty], np.float32),
+            np.asarray([s.seed], np.int32),
             self._next_key(),
         )
         self.prefill_latency_ms = (time.monotonic() - t0) * 1e3
@@ -624,7 +656,8 @@ class ModelRuntime:
             k_steps, self.last_tokens,
             self.seq_lens,  # position of the incoming token
             active_mask, self.page_table, self.temp, self.top_k, self.top_p,
-            self.rep_pen, self._next_key(),
+            self.rep_pen, self.pres_pen, self.freq_pen, self.seeds,
+            self._next_key(),
         )
         toks = np.asarray(toks)  # [K, S]
         self.step_latency_ms = (time.monotonic() - t0) * 1e3 / k_steps
@@ -745,11 +778,22 @@ class EncoderRuntime:
     def step(self, core: MQCore) -> None:
         """Encode up to 8 pending requests in one padded batch."""
         batch: List[Request] = []
+        max_len = self.cfg.max_seq_len
         while self.pending and len(batch) < 8:
             req = self.pending.popleft()
             if req.cancelled.is_set():
                 core.mark_dropped(req.user)
                 req.finish(FinishReason.CANCELLED)
+                continue
+            n = len(req.prompt_tokens)
+            if n > max_len:
+                # Unbounded inputs would double the compile bucket until the
+                # forward OOMs — and a failed step errors every pending
+                # request of this runtime (cross-user blast radius, ADVICE
+                # r1). Mirror step_prefill's max_prompt rejection instead.
+                core.mark_dropped(req.user)
+                req.finish(FinishReason.ERROR,
+                           error=f"input length {n} exceeds maximum {max_len}")
                 continue
             batch.append(req)
         if not batch:
@@ -773,6 +817,9 @@ class EncoderRuntime:
         for i, r in enumerate(batch):
             r.embedding = out[i].tolist()
             r.stats.first_token_at = time.monotonic()
+            # Encoders "generate" their pooled outputs; count processed
+            # tokens so embeddings traffic shows up in TUI tok/s telemetry.
+            self.tokens_generated += int(lens[i])
             core.mark_done(r.user, tokens=int(lens[i]))
             r.finish(FinishReason.STOP)
 

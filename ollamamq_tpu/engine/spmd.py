@@ -75,20 +75,23 @@ class SPMDModelRuntime(ModelRuntime):
         self.spmd_index = 0
 
     def _dispatch_prefill(self, bucket, B, tokens, lens, slot_ids, pt_rows,
-                          temp, tk, tp, pen, key):
+                          temp, tk, tp, pen, pres, freq, seeds, key):
         if self._spmd:
             _bcast(np.asarray([OP_PREFILL, bucket, B, self.spmd_index], np.int32))
             _bcast((np.asarray(tokens, np.int32), np.asarray(lens, np.int32),
                     np.asarray(slot_ids, np.int32),
                     np.asarray(pt_rows, np.int32), np.asarray(temp, np.float32),
                     np.asarray(tk, np.int32), np.asarray(tp, np.float32),
-                    np.asarray(pen, np.float32), np.asarray(key, np.uint32)))
+                    np.asarray(pen, np.float32), np.asarray(pres, np.float32),
+                    np.asarray(freq, np.float32), np.asarray(seeds, np.int32),
+                    np.asarray(key, np.uint32)))
         return super()._dispatch_prefill(
-            bucket, B, tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen, key
+            bucket, B, tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen,
+            pres, freq, seeds, key
         )
 
     def _dispatch_chunk(self, chunk, tokens, start, cl, slot_id, is_final,
-                        pt_row, temp, tk, tp, pen, key):
+                        pt_row, temp, tk, tp, pen, pres, freq, seeds, key):
         if self._spmd:
             _bcast(np.asarray([OP_CHUNK, chunk, 0, self.spmd_index], np.int32))
             _bcast((np.asarray(tokens, np.int32), np.asarray(start, np.int32),
@@ -97,23 +100,27 @@ class SPMDModelRuntime(ModelRuntime):
                     np.asarray(pt_row, np.int32),
                     np.asarray(temp, np.float32), np.asarray(tk, np.int32),
                     np.asarray(tp, np.float32), np.asarray(pen, np.float32),
-                    np.asarray(key, np.uint32)))
+                    np.asarray(pres, np.float32), np.asarray(freq, np.float32),
+                    np.asarray(seeds, np.int32), np.asarray(key, np.uint32)))
         return super()._dispatch_chunk(
             chunk, tokens, start, cl, slot_id, is_final, pt_row, temp, tk,
-            tp, pen, key
+            tp, pen, pres, freq, seeds, key
         )
 
     def _dispatch_decode(self, k_steps, tokens, positions, active, pt, temp,
-                         tk, tp, pen, key):
+                         tk, tp, pen, pres, freq, seeds, key):
         if self._spmd:
             _bcast(np.asarray([OP_DECODE, k_steps, 0, self.spmd_index], np.int32))
             _bcast((np.asarray(tokens, np.int32), np.asarray(positions, np.int32),
                     np.asarray(active, np.int32),
                     np.asarray(pt, np.int32), np.asarray(temp, np.float32),
                     np.asarray(tk, np.int32), np.asarray(tp, np.float32),
-                    np.asarray(pen, np.float32), np.asarray(key, np.uint32)))
+                    np.asarray(pen, np.float32), np.asarray(pres, np.float32),
+                    np.asarray(freq, np.float32), np.asarray(seeds, np.int32),
+                    np.asarray(key, np.uint32)))
         return super()._dispatch_decode(
-            k_steps, tokens, positions, active, pt, temp, tk, tp, pen, key
+            k_steps, tokens, positions, active, pt, temp, tk, tp, pen,
+            pres, freq, seeds, key
         )
 
 class SPMDEngine:
@@ -191,49 +198,54 @@ def run_worker(
         try:
             if op == OP_PREFILL:
                 bucket, B = int(header[1]), int(header[2])
-                (tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen,
-                 key_data) = _bcast((
+                (tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen, pres,
+                 freq, seeds, key_data) = _bcast((
                     np.zeros((B, bucket), np.int32), np.zeros((B,), np.int32),
                     np.zeros((B,), np.int32),
                     np.zeros((B, MP), np.int32), np.zeros((B,), np.float32),
                     np.zeros((B,), np.int32), np.ones((B,), np.float32),
-                    np.ones((B,), np.float32), np.zeros(KEY_SHAPE, np.uint32),
+                    np.ones((B,), np.float32), np.zeros((B,), np.float32),
+                    np.zeros((B,), np.float32), np.zeros((B,), np.int32),
+                    np.zeros(KEY_SHAPE, np.uint32),
                 ))
                 key = jnp.asarray(key_data, jnp.uint32)
                 _, rt.kc, rt.vc, rt.recent = ModelRuntime._dispatch_prefill(
                     rt, bucket, B, tokens, lens, slot_ids, pt_rows, temp,
-                    tk, tp, pen, key
+                    tk, tp, pen, pres, freq, seeds, key
                 )
             elif op == OP_CHUNK:
                 chunk = int(header[1])
                 (tokens, start, cl, slot_id, is_final, pt_row, temp, tk, tp,
-                 pen, key_data) = _bcast((
+                 pen, pres, freq, seeds, key_data) = _bcast((
                     np.zeros((1, chunk), np.int32), np.zeros((1,), np.int32),
                     np.zeros((1,), np.int32), np.zeros((1,), np.int32),
                     np.zeros((1,), np.int32), np.zeros((1, MP), np.int32),
                     np.zeros((1,), np.float32), np.zeros((1,), np.int32),
                     np.ones((1,), np.float32), np.ones((1,), np.float32),
-                    np.zeros(KEY_SHAPE, np.uint32),
+                    np.zeros((1,), np.float32), np.zeros((1,), np.float32),
+                    np.zeros((1,), np.int32), np.zeros(KEY_SHAPE, np.uint32),
                 ))
                 key = jnp.asarray(key_data, jnp.uint32)
                 _, rt.kc, rt.vc, rt.recent = ModelRuntime._dispatch_chunk(
                     rt, chunk, tokens, start, cl, slot_id, is_final, pt_row,
-                    temp, tk, tp, pen, key
+                    temp, tk, tp, pen, pres, freq, seeds, key
                 )
             elif op == OP_DECODE:
                 k_steps = int(header[1])
-                (tokens, positions, active, pt, temp, tk, tp, pen,
-                 key_data) = _bcast((
+                (tokens, positions, active, pt, temp, tk, tp, pen, pres,
+                 freq, seeds, key_data) = _bcast((
                     np.zeros((S,), np.int32), np.zeros((S,), np.int32),
                     np.zeros((S,), np.int32),
                     np.zeros((S, MP), np.int32), np.zeros((S,), np.float32),
                     np.zeros((S,), np.int32), np.ones((S,), np.float32),
-                    np.ones((S,), np.float32), np.zeros(KEY_SHAPE, np.uint32),
+                    np.ones((S,), np.float32), np.zeros((S,), np.float32),
+                    np.zeros((S,), np.float32), np.zeros((S,), np.int32),
+                    np.zeros(KEY_SHAPE, np.uint32),
                 ))
                 key = jnp.asarray(key_data, jnp.uint32)
                 _, rt.kc, rt.vc, rt.recent = ModelRuntime._dispatch_decode(
                     rt, k_steps, tokens, positions, active, pt, temp, tk,
-                    tp, pen, key
+                    tp, pen, pres, freq, seeds, key
                 )
             else:
                 log.error("unknown opcode %d; shutting down", op)

@@ -61,7 +61,10 @@ class HFTokenizer:
         f = path if path.endswith(".json") else os.path.join(path, "tokenizer.json")
         self._tok = Tokenizer.from_file(f)
         self.vocab_size = self._tok.get_vocab_size()
-        self.bos_id = self._first_special(["<|begin_of_text|>", "<s>", "<|im_start|>"])
+        # NB: <|im_start|> is NOT a BOS candidate — ChatML (Qwen) has no BOS
+        # and treating the turn delimiter as one prepends a stray token to
+        # every prompt (ADVICE r1).
+        self.bos_id = self._first_special(["<|begin_of_text|>", "<s>"])
         self.eos_id = self._first_special(
             ["<|eot_id|>", "<|end_of_text|>", "</s>", "<|im_end|>"]
         )

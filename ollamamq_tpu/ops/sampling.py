@@ -23,9 +23,20 @@ class SamplingParams:
     top_k: int = 0  # 0 => disabled
     top_p: float = 1.0
     repeat_penalty: float = 1.0  # 1.0 => off (Ollama's default is 1.1)
-    seed: int = 0
+    presence_penalty: float = 0.0  # additive, OpenAI semantics (0 => off)
+    frequency_penalty: float = 0.0  # additive per occurrence (0 => off)
+    seed: int = 0  # >0 => per-request reproducible sampling stream
     max_tokens: int = 256
     stop: tuple = ()
+
+    def __post_init__(self):
+        # Seeds ride int32 device arrays; an out-of-range value would raise
+        # OverflowError in the engine thread (numpy 2 rejects lossy int32
+        # assignment) and fail every in-flight request on the runtime. Fold
+        # arbitrary client seeds (OpenAI seeds are commonly 64-bit) into
+        # [1, 2^31-1] deterministically; only a literal 0 stays unseeded.
+        s = int(self.seed)
+        self.seed = (s % 0x7FFFFFFE) + 1 if s else 0
 
     @classmethod
     def from_ollama_options(cls, options: dict, max_tokens_default: int) -> "SamplingParams":
@@ -35,6 +46,8 @@ class SamplingParams:
             top_k=int(options.get("top_k", 0) or 0),
             top_p=float(options.get("top_p", 1.0) or 1.0),
             repeat_penalty=float(options.get("repeat_penalty", 1.1) or 1.0),
+            presence_penalty=float(options.get("presence_penalty", 0.0) or 0.0),
+            frequency_penalty=float(options.get("frequency_penalty", 0.0) or 0.0),
             seed=int(options.get("seed", 0) or 0),
             max_tokens=int(options.get("num_predict", max_tokens_default) or max_tokens_default),
             stop=tuple(options.get("stop", []) or []),
@@ -49,6 +62,11 @@ class SamplingParams:
             temperature=float(body.get("temperature", 1.0) or 0.0),
             top_k=0,
             top_p=float(body.get("top_p", 1.0) or 1.0),
+            # Not an OpenAI field, but accepted for parity with clients that
+            # pass Ollama-style options through the /v1 surface.
+            repeat_penalty=float(body.get("repeat_penalty", 1.0) or 1.0),
+            presence_penalty=float(body.get("presence_penalty", 0.0) or 0.0),
+            frequency_penalty=float(body.get("frequency_penalty", 0.0) or 0.0),
             seed=int(body.get("seed", 0) or 0),
             max_tokens=int(
                 body.get("max_tokens") or body.get("max_completion_tokens") or max_tokens_default
@@ -65,6 +83,14 @@ def recent_token_mask(recent: jnp.ndarray, vocab: int) -> jnp.ndarray:
     return mask.at[jnp.arange(B)[:, None], jnp.clip(recent, 0)].max(valid)
 
 
+def recent_token_counts(recent: jnp.ndarray, vocab: int) -> jnp.ndarray:
+    """[B, W] ring of recent token ids (-1 = empty) -> [B, V] int32 counts."""
+    B, _ = recent.shape
+    valid = (recent >= 0).astype(jnp.int32)
+    counts = jnp.zeros((B, vocab), jnp.int32)
+    return counts.at[jnp.arange(B)[:, None], jnp.clip(recent, 0)].add(valid)
+
+
 def apply_repeat_penalty(
     logits: jnp.ndarray,  # [B, V] float32
     recent: jnp.ndarray,  # [B, W] int32 — last-W context token ids (-1 pad)
@@ -79,14 +105,32 @@ def apply_repeat_penalty(
     return jnp.where((mask > 0) & (p != 1.0), penalized, logits)
 
 
-def sample_tokens(
+def apply_penalties(
     logits: jnp.ndarray,  # [B, V] float32
-    key: jax.Array,
+    recent: jnp.ndarray,  # [B, W] int32 — last-W context token ids (-1 pad)
+    repeat: jnp.ndarray,  # [B] multiplicative, llama.cpp semantics (1.0 = off)
+    presence: jnp.ndarray,  # [B] additive once per seen token (0.0 = off)
+    frequency: jnp.ndarray,  # [B] additive per occurrence (0.0 = off)
+) -> jnp.ndarray:
+    """Repetition control over the recent-token window: llama.cpp-style
+    multiplicative repeat_penalty plus OpenAI-style additive presence /
+    frequency penalties (counts come from the same window)."""
+    counts = recent_token_counts(recent, logits.shape[1])
+    seen = counts > 0
+    p = repeat[:, None]
+    penalized = jnp.where(logits > 0, logits / p, logits * p)
+    out = jnp.where(seen & (p != 1.0), penalized, logits)
+    out = out - frequency[:, None] * counts.astype(logits.dtype)
+    return out - presence[:, None] * seen.astype(logits.dtype)
+
+
+def _masked_scaled_logits(
+    logits: jnp.ndarray,  # [B, V] float32
     temperature: jnp.ndarray,  # [B]
     top_k: jnp.ndarray,  # [B] int32 (0 = off)
     top_p: jnp.ndarray,  # [B]
-) -> jnp.ndarray:
-    """Vectorized per-sequence sampling. Greedy where temperature == 0."""
+):
+    """(masked scaled logits, greedy argmax) shared by both samplers."""
     B, V = logits.shape
     greedy = jnp.argmax(logits, axis=-1)
 
@@ -108,6 +152,47 @@ def sample_tokens(
     p_kth = jnp.take_along_axis(sorted_desc, cut_idx[:, None], axis=-1)
     topp_mask = jnp.where((top_p < 1.0)[:, None], scaled >= p_kth, True)
 
-    masked = jnp.where(topk_mask & topp_mask, scaled, -jnp.inf)
+    return jnp.where(topk_mask & topp_mask, scaled, -jnp.inf), greedy
+
+
+def sample_tokens(
+    logits: jnp.ndarray,  # [B, V] float32
+    key: jax.Array,
+    temperature: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B] int32 (0 = off)
+    top_p: jnp.ndarray,  # [B]
+) -> jnp.ndarray:
+    """Vectorized per-sequence sampling. Greedy where temperature == 0."""
+    masked, greedy = _masked_scaled_logits(logits, temperature, top_k, top_p)
     sampled = jax.random.categorical(key, masked, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+
+
+def per_row_keys(
+    key: jax.Array,  # engine-stream key for this dispatch
+    seeds: jnp.ndarray,  # [B] int32; >0 = request-provided seed
+    positions: jnp.ndarray,  # [B] int32 absolute position being sampled
+) -> jnp.ndarray:
+    """[B, 2] uint32 sampling keys. Seeded rows derive their key purely from
+    (seed, position) — replaying the request reproduces the exact stream no
+    matter what else shares the batch; unseeded rows draw from the engine
+    stream, decorrelated per row."""
+    n = seeds.shape[0]
+    unseeded = jax.random.split(key, n)
+    seeded = jax.vmap(jax.random.fold_in)(
+        jax.vmap(jax.random.PRNGKey)(seeds), positions.astype(jnp.uint32)
+    )
+    return jnp.where((seeds > 0)[:, None], seeded, unseeded)
+
+
+def sample_tokens_rowwise(
+    logits: jnp.ndarray,  # [B, V] float32
+    row_keys: jnp.ndarray,  # [B, 2] uint32 (per_row_keys)
+    temperature: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B] int32 (0 = off)
+    top_p: jnp.ndarray,  # [B]
+) -> jnp.ndarray:
+    """sample_tokens with an independent key per row (per-request seeds)."""
+    masked, greedy = _masked_scaled_logits(logits, temperature, top_k, top_p)
+    sampled = jax.vmap(lambda k, l: jax.random.categorical(k, l))(row_keys, masked)
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)

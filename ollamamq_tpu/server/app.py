@@ -139,15 +139,15 @@ class Server:
         except BlockedError as e:
             raise ApiError(403, str(e))
 
-    def _tokenize(self, model: str, text: str):
+    def _tokenize(self, model: str, text: str, add_bos: bool = True):
         rt = self.engine.resolve_runtime(model)
         if rt is None:
             # Not loaded: byte-tokenize as a safe default; the request will
             # wait in queue until the model is pulled anyway.
             from ollamamq_tpu.engine.tokenizer import ByteTokenizer
 
-            return ByteTokenizer().encode(text)
-        return rt.tokenizer.encode(text)
+            return ByteTokenizer().encode(text, add_bos=add_bos)
+        return rt.tokenizer.encode(text, add_bos=add_bos)
 
     async def _collect(self, req: Request) -> list:
         """Await all stream items (non-streaming responses). A disconnect
@@ -296,7 +296,7 @@ class Server:
             body.get("options"), self.engine.ecfg.max_new_tokens
         )
         prompt = render_chat(messages, entry.config if entry else get_model_config(model))
-        tokens = self._tokenize(model, prompt)
+        tokens = self._tokenize(model, prompt, add_bos=False)
         req = self._enqueue(user, ip, model, Family.OLLAMA, tokens, sampling,
                             raw_prompt=prompt)
 
@@ -369,32 +369,41 @@ class Server:
         user, ip = self._ident(request)
         body = await self._body_json(request)
         model = body.get("model", "")
-        self._resolve_model(model)
+        entry = self._resolve_model(model)
         inputs = body.get("input", "")
         single = isinstance(inputs, str)
         texts = [inputs] if single else list(inputs)
-        vectors = await self._embed_batch(user, ip, model, texts)
+        vectors, counts = await self._embed_batch(user, ip, model, texts, entry)
         return web.json_response({
             "model": model,
             "embeddings": vectors,
             "total_duration": 0,
             "load_duration": 0,
-            "prompt_eval_count": sum(len(t) for t in texts),
+            "prompt_eval_count": sum(counts),
         })
 
     async def api_embeddings_legacy(self, request: web.Request) -> web.Response:
         user, ip = self._ident(request)
         body = await self._body_json(request)
         model = body.get("model", "")
-        self._resolve_model(model)
+        entry = self._resolve_model(model)
         prompt = body.get("prompt", "")
-        vectors = await self._embed_batch(user, ip, model, [prompt])
+        vectors, _ = await self._embed_batch(user, ip, model, [prompt], entry)
         return web.json_response({"embedding": vectors[0] if vectors else []})
 
-    async def _embed_batch(self, user, ip, model, texts):
-        reqs = []
+    async def _embed_batch(self, user, ip, model, texts, entry):
+        """Returns (vectors, per-input token counts). `entry` is the
+        caller's _resolve_model result. Rejects generative models with 400:
+        ModelRuntime has no pooled-embedding path, so an embed request
+        against one would burn a decode slot and return nothing (ADVICE
+        r1)."""
+        cfg = entry.config if entry else get_model_config(model)
+        if cfg is None or not cfg.is_encoder:
+            raise ApiError(400, f"model '{model}' is not an embedding model")
+        reqs, counts = [], []
         for t in texts:
             tokens = self._tokenize(model, t)
+            counts.append(len(tokens))
             req = self._enqueue(user, ip, model, Family.OLLAMA, tokens,
                                 SamplingParams(), kind="embed", raw_prompt=t)
             reqs.append(req)
@@ -405,7 +414,7 @@ class Server:
             if err is not None:
                 raise ApiError(500, f"engine error: {err.error}")
             out.append(req.embedding or [])
-        return out
+        return out, counts
 
     # --------------------------------------------------------- registry api
     async def api_tags(self, request: web.Request) -> web.Response:
@@ -516,7 +525,7 @@ class Server:
         stream = body.get("stream", False)
         sampling = SamplingParams.from_openai(body, self.engine.ecfg.max_new_tokens)
         prompt = render_chat(messages, entry.config if entry else get_model_config(model))
-        tokens = self._tokenize(model, prompt)
+        tokens = self._tokenize(model, prompt, add_bos=False)
         req = self._enqueue(user, ip, model, Family.OPENAI, tokens, sampling,
                             raw_prompt=prompt)
         rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
@@ -647,10 +656,10 @@ class Server:
         user, ip = self._ident(request)
         body = await self._body_json(request)
         model = body.get("model", "")
-        self._resolve_model(model)
+        entry = self._resolve_model(model)
         inputs = body.get("input", "")
         texts = [inputs] if isinstance(inputs, str) else list(inputs)
-        vectors = await self._embed_batch(user, ip, model, texts)
+        vectors, counts = await self._embed_batch(user, ip, model, texts, entry)
         return web.json_response({
             "object": "list",
             "data": [
@@ -658,8 +667,8 @@ class Server:
                 for i, v in enumerate(vectors)
             ],
             "model": model,
-            "usage": {"prompt_tokens": sum(len(t) for t in texts),
-                      "total_tokens": sum(len(t) for t in texts)},
+            "usage": {"prompt_tokens": sum(counts),
+                      "total_tokens": sum(counts)},
         })
 
     async def v1_models(self, request: web.Request) -> web.Response:

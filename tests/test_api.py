@@ -181,6 +181,38 @@ async def test_embeddings_all_shapes(client):
 
 
 @api_test
+async def test_embed_on_generative_model_400(client):
+    """Embedding routes against a generative model reject with 400 instead
+    of silently burning a decode slot and returning [] (ADVICE r1)."""
+    for route, body in (
+        ("/api/embed", {"model": "test-tiny", "input": "a"}),
+        ("/api/embeddings", {"model": "test-tiny", "prompt": "a"}),
+        ("/v1/embeddings", {"model": "test-tiny", "input": "a"}),
+    ):
+        r = await client.post(route, json=body)
+        assert r.status == 400, f"{route}: {r.status}"
+        assert "not an embedding model" in (await r.json())["error"]
+
+
+@api_test
+async def test_embed_token_counts(client):
+    """prompt_eval_count / usage count TOKENS, not characters (ADVICE r1:
+    '☃' is one char but several byte-tokens)."""
+    r = await client.post("/api/embed", json={
+        "model": "test-tiny-embed", "input": ["☃☃"],
+    })
+    body = await r.json()
+    # byte tokenizer: bos + 6 utf-8 bytes = 7 tokens (chars would say 2)
+    assert body["prompt_eval_count"] == 7
+
+    r = await client.post("/v1/embeddings", json={
+        "model": "test-tiny-embed", "input": "☃☃",
+    })
+    usage = (await r.json())["usage"]
+    assert usage["prompt_tokens"] == 7 and usage["total_tokens"] == 7
+
+
+@api_test
 async def test_tags_ps_show_version(client):
     r = await client.get("/api/tags")
     tags = await r.json()

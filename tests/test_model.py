@@ -228,6 +228,42 @@ def test_chunked_prefill_ragged_last_chunk(tiny_cfg, tiny_params):
     )
 
 
+def test_apply_penalties_math():
+    from ollamamq_tpu.ops.sampling import apply_penalties
+
+    logits = jnp.array([[2.0, -2.0, 1.0, -1.0]])
+    recent = jnp.array([[1, 1, 0, -1]], jnp.int32)  # id1 twice, id0 once
+    one = jnp.array([1.0])
+    zero = jnp.array([0.0])
+    # repeat only: matches apply_repeat_penalty semantics
+    out = np.asarray(apply_penalties(logits, recent, jnp.array([2.0]), zero, zero))
+    np.testing.assert_allclose(out, [[1.0, -4.0, 1.0, -1.0]])
+    # presence: flat -0.5 on seen ids regardless of count
+    out = np.asarray(apply_penalties(logits, recent, one, jnp.array([0.5]), zero))
+    np.testing.assert_allclose(out, [[1.5, -2.5, 1.0, -1.0]])
+    # frequency: -0.5 per occurrence (id1 seen twice)
+    out = np.asarray(apply_penalties(logits, recent, one, zero, jnp.array([0.5])))
+    np.testing.assert_allclose(out, [[1.5, -3.0, 1.0, -1.0]])
+    # all off => identity
+    out = np.asarray(apply_penalties(logits, recent, one, zero, zero))
+    np.testing.assert_allclose(out, np.asarray(logits))
+
+
+def test_per_row_keys_seed_isolation():
+    """Seeded rows depend only on (seed, position); unseeded rows follow the
+    engine stream key."""
+    from ollamamq_tpu.ops.sampling import per_row_keys
+
+    seeds = jnp.array([7, 0], jnp.int32)
+    pos = jnp.array([5, 5], jnp.int32)
+    k1 = per_row_keys(jax.random.PRNGKey(1), seeds, pos)
+    k2 = per_row_keys(jax.random.PRNGKey(2), seeds, pos)
+    assert np.array_equal(k1[0], k2[0])  # seeded: engine key irrelevant
+    assert not np.array_equal(k1[1], k2[1])  # unseeded: engine key matters
+    k3 = per_row_keys(jax.random.PRNGKey(1), seeds, jnp.array([6, 5], jnp.int32))
+    assert not np.array_equal(k1[0], k3[0])  # position advances the stream
+
+
 def test_apply_repeat_penalty_math():
     from ollamamq_tpu.ops.sampling import apply_repeat_penalty
 
