@@ -66,6 +66,13 @@ MODEL_CONFIGS = {
         num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
         rope_theta=10_000.0, max_seq_len=512,
     ),
+    # GQA variant with enough KV heads for tp=4 sharding tests (test-tiny's
+    # 2 KV heads cap it at tp=2).
+    "test-tiny-gqa": ModelConfig(
+        name="test-tiny-gqa", vocab_size=512, hidden_size=128,
+        intermediate_size=256, num_layers=2, num_heads=8, num_kv_heads=4,
+        head_dim=16, rope_theta=10_000.0, max_seq_len=512,
+    ),
     "test-tiny-qwen": ModelConfig(
         name="test-tiny-qwen", vocab_size=512, hidden_size=64, intermediate_size=128,
         num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,

@@ -82,6 +82,7 @@ class ModelRuntime:
         mesh=None,
         checkpoint_path: Optional[str] = None,
         dtype=jnp.bfloat16,
+        preloaded_params=None,
     ):
         self.name = name
         self.cfg = model_cfg
@@ -93,8 +94,13 @@ class ModelRuntime:
             validate_tp_for_model(
                 mesh.shape["tensor"], model_cfg.num_kv_heads, model_cfg.num_heads
             )
-        params = weights.load_params(
-            model_cfg, checkpoint_path, seed=engine_cfg.seed, dtype=dtype
+        # `preloaded_params`: host-side tree shared across dp replicas so a
+        # checkpoint is read/parsed once, not once per replica; each replica
+        # still device_puts its own copy via shard_params below.
+        params = preloaded_params if preloaded_params is not None else (
+            weights.load_params(
+                model_cfg, checkpoint_path, seed=engine_cfg.seed, dtype=dtype
+            )
         )
         kv_sharding = None
         if mesh is not None:
@@ -839,6 +845,87 @@ class EncoderRuntime:
         }
 
 
+class ReplicaSet:
+    """Data parallelism as replica serving: dp independent ModelRuntimes for
+    one model, each TP-sharded over its own slice of the mesh's data axis,
+    with least-loaded placement and round-robin rotation among ties — the
+    TPU analogue of the reference's least-connections backend pick
+    (dispatcher.rs:475-487). Each replica holds its own params copy, KV
+    pool, and jits, so replicas step independently (and their dispatches
+    overlap on disjoint device sets)."""
+
+    def __init__(self, replicas: List[ModelRuntime]):
+        assert replicas
+        self.replicas = list(replicas)
+        self.name = self.replicas[0].name
+        self.cfg = self.replicas[0].cfg
+        self.ecfg = self.replicas[0].ecfg
+        self._last_idx = 0  # rotation cursor (dispatcher.rs last_backend_idx)
+
+    # -- placement ---------------------------------------------------------
+    @staticmethod
+    def _load(rt: ModelRuntime) -> int:
+        return rt.active_count() + len(rt.pending_prefill) + len(rt.chunking)
+
+    def has_capacity(self) -> bool:
+        return any(r.has_capacity() for r in self.replicas)
+
+    def submit(self, req: Request) -> None:
+        """Least-loaded replica wins; ties rotate after the previous pick."""
+        eligible = [i for i, r in enumerate(self.replicas) if r.has_capacity()]
+        if not eligible:  # capacity raced away; park on the least loaded
+            eligible = list(range(len(self.replicas)))
+        best = min(self._load(self.replicas[i]) for i in eligible)
+        ties = {i for i in eligible if self._load(self.replicas[i]) == best}
+        n = len(self.replicas)
+        for off in range(1, n + 1):
+            i = (self._last_idx + off) % n
+            if i in ties:
+                self._last_idx = i
+                self.replicas[i].submit(req)
+                return
+
+    # -- aggregate runtime surface (registry / health / TUI / app) ---------
+    @property
+    def tokenizer(self):
+        return self.replicas[0].tokenizer
+
+    @property
+    def param_bytes(self) -> int:
+        return sum(r.param_bytes for r in self.replicas)
+
+    @property
+    def kv_bytes(self) -> int:
+        return sum(r.kv_bytes for r in self.replicas)
+
+    @property
+    def tokens_generated(self) -> int:
+        return sum(r.tokens_generated for r in self.replicas)
+
+    def has_work(self) -> bool:
+        return any(r.has_work() for r in self.replicas)
+
+    def active_count(self) -> int:
+        return sum(r.active_count() for r in self.replicas)
+
+    def check_cancellations(self, core: MQCore) -> None:
+        for r in self.replicas:
+            r.check_cancellations(core)
+
+    def stats(self) -> dict:
+        per = [r.stats() for r in self.replicas]
+        agg = dict(per[0])
+        for key in ("active_slots", "max_slots", "pending_prefill",
+                    "pages_used", "pages_total", "tokens_generated",
+                    "param_bytes", "kv_bytes"):
+            agg[key] = sum(p[key] for p in per)
+        for key in ("step_latency_ms", "step_p50_ms", "step_p99_ms",
+                    "prefill_latency_ms", "ttft_p50_ms", "ttft_p99_ms"):
+            agg[key] = max(p[key] for p in per)
+        agg["replicas"] = len(per)
+        return agg
+
+
 class TPUEngine:
     """Engine front: owns the scheduler core, model runtimes, and the loop."""
 
@@ -885,10 +972,30 @@ class TPUEngine:
         if name in self.runtimes:
             return
         cls = EncoderRuntime if cfg.is_encoder else self.runtime_class
-        self.runtimes[name] = cls(
-            name, cfg, self.ecfg, mesh=self.mesh,
-            checkpoint_path=checkpoint_path, dtype=self.dtype,
-        )
+        if not cfg.is_encoder and self.ecfg.dp > 1 and self.mesh is not None:
+            # dp replicas, each on its own slice of the mesh's data axis
+            # (a [1, sp, tp] submesh): N params copies + KV pools serving
+            # concurrently — the reference's "one request per backend, N
+            # backends" scale-out story with backends = mesh slices.
+            from jax.sharding import Mesh
+
+            host_params = weights.load_params(
+                cfg, checkpoint_path, seed=self.ecfg.seed, dtype=self.dtype
+            )
+            reps = [
+                cls(name, cfg, self.ecfg,
+                    mesh=Mesh(self.mesh.devices[r:r + 1], self.mesh.axis_names),
+                    checkpoint_path=checkpoint_path, dtype=self.dtype,
+                    preloaded_params=host_params)
+                for r in range(self.ecfg.dp)
+            ]
+            del host_params  # replicas hold their own device copies
+            self.runtimes[name] = ReplicaSet(reps)
+        else:
+            self.runtimes[name] = cls(
+                name, cfg, self.ecfg, mesh=self.mesh,
+                checkpoint_path=checkpoint_path, dtype=self.dtype,
+            )
         log.info("loaded model %s (%.1f MB params)", name,
                  self.runtimes[name].param_bytes / 1e6)
         self.notify()
@@ -966,8 +1073,9 @@ class TPUEngine:
             return
         if req is None:
             # Already admitted: find it in a runtime (active slot or
-            # waiting for prefill).
-            for rt in list(self.runtimes.values()):
+            # waiting for prefill). _step_targets flattens replica sets —
+            # requests live on the individual replicas, never the set.
+            for rt in self._step_targets():
                 holders = (
                     list(getattr(rt, "slot_req", []))
                     + list(getattr(rt, "active", []))
@@ -997,7 +1105,7 @@ class TPUEngine:
             # No model requested: any generative runtime (reference lets
             # Unknown-family tasks hit any backend, dispatcher.rs:453-461).
             for rt in self.runtimes.values():
-                if isinstance(rt, ModelRuntime):
+                if isinstance(rt, (ModelRuntime, ReplicaSet)):
                     return rt
             return next(iter(self.runtimes.values()), None)
         key = smart_match(model, self.runtimes.keys())
@@ -1112,11 +1220,23 @@ class TPUEngine:
         rt.submit(req)
         return True
 
+    def _step_targets(self) -> List[object]:
+        """Individually-steppable runtimes: replica sets flatten so each
+        replica advances every tick (their device dispatches overlap —
+        disjoint device sets execute concurrently)."""
+        out: List[object] = []
+        for rt in self.runtimes.values():
+            if isinstance(rt, ReplicaSet):
+                out.extend(rt.replicas)
+            else:
+                out.append(rt)
+        return out
+
     def _loop(self) -> None:
         while self._running:
             self._admit()
             did_work = False
-            for rt in list(self.runtimes.values()):
+            for rt in self._step_targets():
                 try:
                     rt.check_cancellations(self.core)
                     if isinstance(rt, ModelRuntime):

@@ -143,6 +143,12 @@ class SPMDEngine:
                         "embedding models are not supported under --spmd yet "
                         "(no OP_ENCODE in the worker protocol)"
                     )
+                if self.ecfg.dp > 1:
+                    raise NotImplementedError(
+                        "dp replica serving under --spmd is not supported "
+                        "yet (the worker replay protocol carries no replica "
+                        "ordinal); use dp on single-host deployments"
+                    )
                 if self._running and jax.process_count() > 1:
                     raise NotImplementedError(
                         "runtime model load (/api/pull) is not supported "

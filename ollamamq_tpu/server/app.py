@@ -32,7 +32,7 @@ from ollamamq_tpu.core.mqcore import BlockedError, Family
 from ollamamq_tpu.engine.request import FinishReason, Request, StreamItem
 from ollamamq_tpu.ops.sampling import SamplingParams
 from ollamamq_tpu.server.registry import ModelRegistry
-from ollamamq_tpu.server.templates import render_chat
+from ollamamq_tpu.server.templates import render_chat, template_owns_bos
 
 log = logging.getLogger("ollamamq.server")
 
@@ -295,8 +295,12 @@ class Server:
         sampling = SamplingParams.from_ollama_options(
             body.get("options"), self.engine.ecfg.max_new_tokens
         )
-        prompt = render_chat(messages, entry.config if entry else get_model_config(model))
-        tokens = self._tokenize(model, prompt, add_bos=False)
+        chat_cfg = entry.config if entry else get_model_config(model)
+        prompt = render_chat(messages, chat_cfg)
+        # Templates that emit their own BOS (or define none) must not get a
+        # second one from the tokenizer; plain-fallback models still do.
+        tokens = self._tokenize(model, prompt,
+                                add_bos=not template_owns_bos(chat_cfg))
         req = self._enqueue(user, ip, model, Family.OLLAMA, tokens, sampling,
                             raw_prompt=prompt)
 
@@ -524,8 +528,12 @@ class Server:
         messages = body.get("messages", [])
         stream = body.get("stream", False)
         sampling = SamplingParams.from_openai(body, self.engine.ecfg.max_new_tokens)
-        prompt = render_chat(messages, entry.config if entry else get_model_config(model))
-        tokens = self._tokenize(model, prompt, add_bos=False)
+        chat_cfg = entry.config if entry else get_model_config(model)
+        prompt = render_chat(messages, chat_cfg)
+        # Templates that emit their own BOS (or define none) must not get a
+        # second one from the tokenizer; plain-fallback models still do.
+        tokens = self._tokenize(model, prompt,
+                                add_bos=not template_owns_bos(chat_cfg))
         req = self._enqueue(user, ip, model, Family.OPENAI, tokens, sampling,
                             raw_prompt=prompt)
         rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"

@@ -13,6 +13,18 @@ from typing import List, Optional
 from ollamamq_tpu.config import ModelConfig
 
 
+def template_owns_bos(cfg: Optional[ModelConfig]) -> bool:
+    """True when the chat template emits its own begin-of-sequence text
+    (Llama-3's <|begin_of_text|>) or the format defines none (ChatML).
+    Plain-fallback models still need the tokenizer's BOS prepended —
+    callers pass add_bos=not template_owns_bos(cfg) to encode()."""
+    if cfg is None:
+        return False
+    if cfg.attn_bias:  # ChatML: no BOS concept
+        return True
+    return not cfg.is_encoder and cfg.vocab_size > 100_000  # Llama-3 header
+
+
 def render_chat(messages: List[dict], cfg: Optional[ModelConfig]) -> str:
     """Render an Ollama/OpenAI-style messages list into a prompt."""
     msgs = []

@@ -1,0 +1,163 @@
+"""Data-parallel replica serving: dp=2 x tp=4 on the 8-virtual-device CPU
+mesh (VERDICT r1 item 4). Each replica is an independent ModelRuntime
+TP-sharded over its own slice of the mesh's data axis; placement is
+least-loaded with round-robin rotation (dispatcher.rs:475-487 analogue)."""
+
+import time
+
+import jax
+import pytest
+
+from ollamamq_tpu.config import EngineConfig
+from ollamamq_tpu.engine.engine import ReplicaSet, TPUEngine
+from ollamamq_tpu.engine.request import FinishReason, Request
+from ollamamq_tpu.ops.sampling import SamplingParams
+
+
+def dp_cfg(**kw):
+    defaults = dict(
+        model="test-tiny-gqa", max_slots=2, num_pages=64, page_size=8,
+        max_pages_per_seq=16, prefill_buckets=(16, 32, 64),
+        max_new_tokens=8, decode_steps_per_iter=2, dp=2, tp=4,
+    )
+    defaults.update(kw)
+    return EngineConfig(**defaults)
+
+
+@pytest.fixture(scope="module")
+def dp_engine():
+    eng = TPUEngine(dp_cfg(), blocklist_path=None)
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def collect(req, timeout=120):
+    deadline = time.monotonic() + timeout
+    items = []
+    while time.monotonic() < deadline:
+        item = req.stream.get(timeout=0.2)
+        if item is None:
+            continue
+        items.append(item)
+        if item.kind in ("done", "error"):
+            return items
+    raise TimeoutError(f"request {req.req_id} did not finish")
+
+
+def test_replicas_shard_over_disjoint_device_slices(dp_engine):
+    """dp=2 builds two runtimes whose param shards live on DISJOINT 4-device
+    subsets of the 8-device mesh (per-replica shards differ — this is
+    replication of the model, not of the work)."""
+    rs = dp_engine.runtimes["test-tiny-gqa"]
+    assert isinstance(rs, ReplicaSet) and len(rs.replicas) == 2
+    device_sets = []
+    for rt in rs.replicas:
+        leaf = jax.tree_util.tree_leaves(rt.params)[0]
+        device_sets.append({d.id for d in leaf.sharding.device_set})
+    assert device_sets[0] and device_sets[1]
+    assert device_sets[0].isdisjoint(device_sets[1])
+    # TP really sharded: each replica's tensor axis spans its 4 devices.
+    assert all(len(s) == 4 for s in device_sets)
+
+
+def test_two_requests_land_on_different_replicas(dp_engine):
+    """Least-loaded placement spreads concurrent requests across replicas,
+    and both generate correctly (greedy => identical outputs for identical
+    prompts, which also pins replica weight equivalence)."""
+    rs = dp_engine.runtimes["test-tiny-gqa"]
+    tok = rs.tokenizer
+    reqs = []
+    for i, user in enumerate(("dp-a", "dp-b")):
+        rid = dp_engine.core.enqueue(user, "", "test-tiny-gqa")
+        req = Request(rid, user, "test-tiny-gqa", tok.encode("same prompt"),
+                      SamplingParams(max_tokens=6))
+        reqs.append(req)
+    for r in reqs:
+        dp_engine.submit(r)
+    outs = [collect(r) for r in reqs]
+    assert all(o[-1].kind == "done" for o in outs)
+    # Both replicas were exercised.
+    assert all(rt.tokens_generated > 0 for rt in rs.replicas), [
+        rt.tokens_generated for rt in rs.replicas
+    ]
+    # Identical random-init seed + greedy => identical tokens on BOTH
+    # replicas: per-replica param shards differ in placement, not values.
+    assert reqs[0].generated_ids == reqs[1].generated_ids
+
+
+def test_least_loaded_placement_and_rotation():
+    """Placement picks the least-loaded replica; ties rotate (reference
+    least-conn + rotate-after-last, dispatcher.rs:475-487)."""
+
+    class FakeReplica:
+        def __init__(self):
+            self.pending_prefill = []
+            self.chunking = []
+            self.submitted = []
+            self.capacity = True
+
+        def has_capacity(self):
+            return self.capacity
+
+        def active_count(self):
+            return len(self.submitted)
+
+        def submit(self, req):
+            self.submitted.append(req)
+
+        name = "fake"
+        cfg = ecfg = None
+
+    a, b, c = FakeReplica(), FakeReplica(), FakeReplica()
+    rs = ReplicaSet.__new__(ReplicaSet)
+    rs.replicas = [a, b, c]
+    rs._last_idx = 0
+    # All empty: rotation starts after index 0 => b, then ties rotate c, a.
+    rs.submit("r1")
+    assert b.submitted == ["r1"]
+    rs.submit("r2")
+    assert c.submitted == ["r2"]
+    rs.submit("r3")
+    assert a.submitted == ["r3"]
+    # Load-based: make b busiest, c without capacity => a wins.
+    b.submitted += ["x", "y"]
+    c.capacity = False
+    rs.submit("r4")
+    assert a.submitted == ["r3", "r4"]
+
+
+def test_cancel_reaches_replica_held_request(dp_engine):
+    """engine.cancel() finds requests held INSIDE a replica (client
+    disconnects must cancel + reclaim under dp>1, not run to max_tokens)."""
+    rs = dp_engine.runtimes["test-tiny-gqa"]
+    for rt in rs.replicas:
+        rt.tokenizer.eos_id = -1  # keep generating until cancelled
+    free_before = [rt.alloc.free_pages for rt in rs.replicas]
+    tok = rs.tokenizer
+    rid = dp_engine.core.enqueue("dp-cancel", "", "test-tiny-gqa")
+    req = Request(rid, "dp-cancel", "test-tiny-gqa", tok.encode("cancel me"),
+                  SamplingParams(max_tokens=10_000))
+    dp_engine.submit(req)
+    deadline = time.monotonic() + 60
+    while not req.stats.first_token_at and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert req.stats.first_token_at, "never started generating"
+    dp_engine.cancel(rid)
+    items = collect(req)
+    assert items[-1].finish_reason == FinishReason.CANCELLED
+    deadline = time.monotonic() + 10
+    while ([rt.alloc.free_pages for rt in rs.replicas] != free_before
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
+    assert [rt.alloc.free_pages for rt in rs.replicas] == free_before
+    for rt in rs.replicas:
+        rt.tokenizer.eos_id = 2  # restore for other tests
+
+
+def test_fairness_counters_shared_across_replicas(dp_engine):
+    """Replicas share ONE scheduler core: processed counts accumulate per
+    user regardless of which replica served them."""
+    snap = dp_engine.core.snapshot()
+    assert snap["users"]["dp-a"]["processed"] >= 1
+    assert snap["users"]["dp-b"]["processed"] >= 1
