@@ -45,6 +45,9 @@ def main() -> int:
     p.add_argument("--chunk", type=int, default=16, help="decode steps per dispatch")
     p.add_argument("--warmup-steps", type=int, default=32)
     p.add_argument("--ttft-samples", type=int, default=8)
+    p.add_argument("--long-prompt", type=int, default=0,
+                   help="if >0, also time chunked prefill of a prompt this "
+                        "long (should exceed the largest bucket)")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU platform (smoke-testing the harness)")
     p.add_argument("--init-timeout", type=float, default=300.0,
@@ -55,8 +58,9 @@ def main() -> int:
     # Everything that can fail on operator error must fail BEFORE the first
     # device touch: a wedged TPU tunnel makes jax.devices() hang, and an
     # argument typo must not spend (or wedge) the one chip claim.
-    if min(args.slots, args.prompt_len, args.steps, args.chunk,
-           args.ttft_samples) < 1 or args.warmup_steps < 0:
+    if (min(args.slots, args.prompt_len, args.steps, args.chunk,
+            args.ttft_samples) < 1 or args.warmup_steps < 0
+            or args.long_prompt < 0):
         _emit_error("invalid arguments: counts must be positive")
         return 2
 
@@ -104,7 +108,8 @@ def main() -> int:
         _emit_error(f"backend init failed: {type(e).__name__}: {e}", phase="init")
         return 3
     # Pages: prompt + generated headroom for every slot.
-    tokens_per_seq = args.prompt_len + args.steps + args.chunk
+    tokens_per_seq = max(args.prompt_len + args.steps + args.chunk,
+                         args.long_prompt + args.chunk)
     page_size = 16
     pages_per_seq = -(-tokens_per_seq // page_size) + 1
     ecfg = EngineConfig(
@@ -154,8 +159,39 @@ def main() -> int:
     ttft_compile_ms = ttfts[0]
     ttft_p50_ms = statistics.median(ttfts[1:]) if len(ttfts) > 1 else ttfts[0]
 
+    rt.tokenizer.eos_id = -1  # keep sequences alive (incl. long-prompt runs)
+
+    # Long-prompt prefill: a prompt 4x the largest bucket streams through
+    # the chunked path (block-wise paged attention) — tracks the HBM-gap
+    # work on long-context prefill. Timed after a compile pass.
+    long_ms = None
+    if args.long_prompt:
+        from ollamamq_tpu.engine.request import FinishReason
+
+        def run_long(i):
+            prompt = rng.integers(3, min(model_cfg.vocab_size, 30000),
+                                  size=args.long_prompt).tolist()
+            req = Request(5000 + i, f"lpuser{i}", args.model, prompt,
+                          SamplingParams(max_tokens=10**9))
+            req._inc_decode = rt.tokenizer.make_incremental_decoder()
+            rt.pending_prefill.append(req)
+            t0 = time.monotonic()
+            while rt.pending_prefill or rt.chunking:
+                rt.step_prefill(core)
+                rt.step_chunk(core)
+            ms = (time.monotonic() - t0) * 1e3
+            installed = any(r is req for r in rt.slot_req)
+            for s, r in enumerate(rt.slot_req):
+                if r is not None:
+                    rt._finish_slot(s, FinishReason.CANCELLED, core)
+            if not installed:
+                raise RuntimeError("long prompt rejected (pages too small?)")
+            return ms
+
+        run_long(0)  # compile
+        long_ms = statistics.median(run_long(i) for i in range(1, 4))
+
     # Fill every slot.
-    rt.tokenizer.eos_id = -1  # keep sequences alive for the whole bench
     for i in range(args.slots):
         rt.pending_prefill.append(make_req(i))
         rt.step_prefill(core)
@@ -212,6 +248,9 @@ def main() -> int:
         "attn_impl": rt.attn_impl,
         "attn_fallback": attn_fallback,
     }
+    if long_ms is not None:
+        result["long_prompt_len"] = args.long_prompt
+        result["long_prefill_ms"] = round(long_ms, 1)
     print(json.dumps(result), flush=True)
     return 0
 

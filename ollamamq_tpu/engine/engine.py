@@ -147,6 +147,8 @@ class ModelRuntime:
         self._prefill_jits: Dict[tuple, callable] = {}  # (bucket, B) | ("chunk", C)
         self._decode_jits: Dict[int, callable] = {}
         self._rng_counter = engine_cfg.seed
+        # Sequence-parallel prefill available when the mesh has a seq axis.
+        self._sp = mesh is not None and mesh.shape.get("seq", 1) > 1
         # Ragged paged-attention Pallas kernel on TPU; jnp gather fallback
         # elsewhere (and under OLLAMAMQ_NO_PALLAS=1 for A/B benching).
         no_pallas = os.environ.get("OLLAMAMQ_NO_PALLAS", "").lower() not in (
@@ -306,6 +308,100 @@ class ModelRuntime:
             self._prefill_jits[("chunk", chunk)] = jax.jit(fn, donate_argnums=(4, 5, 6))
         return self._prefill_jits[("chunk", chunk)]
 
+    def _dispatch_prefill_sp(self, T, tokens, lens, slot_ids, pt_rows,
+                             temp, tk, tp, pen, pres, freq, seeds, key):
+        fn = self._get_sp_prefill_jit(T)
+        return fn(self.params, jnp.asarray(tokens), jnp.asarray(lens),
+                  self.kc, self.vc, self.recent, jnp.asarray(slot_ids),
+                  jnp.asarray(pt_rows), jnp.asarray(temp), jnp.asarray(tk),
+                  jnp.asarray(tp), jnp.asarray(pen), jnp.asarray(pres),
+                  jnp.asarray(freq), jnp.asarray(seeds), key)
+
+    def _get_sp_prefill_jit(self, T: int):
+        """Sequence-parallel long-prompt prefill: the whole prompt in one
+        forward with activations sharded along T over the mesh "seq" axis
+        (ring attention rotates K/V blocks over ICI —
+        models/llama.py:forward_prefill_sp), then the returned K/V stacks
+        scatter into the slot's pages. One compile per padded length T."""
+        key_ = ("sp", T)
+        if key_ not in self._prefill_jits:
+            cfg, ps, mesh = self.cfg, self.ecfg.page_size, self.mesh
+
+            def fn(params, tokens, seq_lens, kc, vc, recent, slot_ids, pt,
+                   temp, tk, tp, pen, pres, freq, seeds, key):
+                logits, k_stack, v_stack = llama.forward_prefill_sp(
+                    params, cfg, tokens, seq_lens, mesh
+                )
+                # Scatter K/V (k_stack: [L, 1, T, Hk, hd]) into the paged
+                # pool; positions past the real length land in the trash
+                # page (pt rows beyond the allocation already hold it).
+                t = jnp.arange(T)
+                page_idx = pt[0, t // ps]
+                page_idx = jnp.where(t < seq_lens[0], page_idx, kvc.TRASH_PAGE)
+                dest = page_idx * ps + (t % ps)
+                kc = kc.at[:, dest].set(k_stack[:, 0].astype(kc.dtype))
+                vc = vc.at[:, dest].set(v_stack[:, 0].astype(vc.dtype))
+                # First-token sampling + recent ring, as in batched prefill.
+                W = recent.shape[1]
+                idx = seq_lens[:, None] - W + jnp.arange(W)[None, :]
+                gathered = jnp.take_along_axis(
+                    tokens, jnp.clip(idx, 0, T - 1), axis=1
+                )
+                rows = jnp.where(idx >= 0, gathered, -1)
+                pen_logits = apply_penalties(logits, rows, pen, pres, freq)
+                row_keys = per_row_keys(key, seeds, seq_lens)
+                tok = sample_tokens_rowwise(pen_logits, row_keys, temp, tk, tp)
+                rows = jnp.concatenate([rows[:, 1:], tok[:, None]], axis=1)
+                recent = recent.at[slot_ids].set(rows)
+                return tok, kc, vc, recent
+
+            self._prefill_jits[key_] = jax.jit(fn, donate_argnums=(3, 4, 5))
+        return self._prefill_jits[key_]
+
+    def _prefill_sp(self, req: Request, slot: int, n: int, core: MQCore) -> None:
+        """Run the sequence-parallel prefill for one long prompt and install
+        the slot. Caller has claimed the slot and allocated pages."""
+        s = req.sampling
+        sp = self.mesh.shape["seq"]
+        largest = self.ecfg.prefill_buckets[-1]
+        unit = -(-largest // sp) * sp  # bucket rounded up to sp-divisible
+        T = -(-n // unit) * unit  # padded length, divisible by sp
+        self.page_table[slot, :] = kvc.make_page_table_row(
+            self.slot_pages[slot], self.ecfg.max_pages_per_seq
+        )
+        tokens = np.zeros((1, T), np.int32)
+        tokens[0, :n] = req.prompt_tokens
+        self.inflight_prefill = [req]  # cancel() must still find it
+        t0 = time.monotonic()
+        try:
+            tok, self.kc, self.vc, self.recent = self._dispatch_prefill_sp(
+                T, tokens, np.asarray([n], np.int32),
+                np.asarray([slot], np.int32), self.page_table[slot:slot + 1],
+                np.asarray([s.temperature], np.float32),
+                np.asarray([s.top_k], np.int32),
+                np.asarray([s.top_p], np.float32),
+                np.asarray([s.repeat_penalty], np.float32),
+                np.asarray([s.presence_penalty], np.float32),
+                np.asarray([s.frequency_penalty], np.float32),
+                np.asarray([s.seed], np.int32),
+                self._next_key(),
+            )
+        except Exception as e:
+            # Contain the failure to THIS request (the batched path does the
+            # same): release the never-installed slot's pages — _fail_runtime
+            # would miss them since slot_req[slot] is still None — and keep
+            # every other in-flight request alive.
+            log.exception("sequence-parallel prefill failed for req %d",
+                          req.req_id)
+            self._release_slot_pages(slot)
+            core.mark_dropped(req.user)
+            req.finish(FinishReason.ERROR, error=f"sp prefill failed: {e}")
+            return
+        finally:
+            self.inflight_prefill = []
+        self.prefill_latency_ms = (time.monotonic() - t0) * 1e3
+        self._install_slot(slot, req, n, int(np.asarray(tok)[0]), core)
+
     def _get_decode_jit(self, k_steps: int):
         if k_steps not in self._decode_jits:
             cfg, ps = self.cfg, self.ecfg.page_size
@@ -456,6 +552,13 @@ class ModelRuntime:
                 self.pending_prefill.popleft()
                 req.stats.prefill_started_at = time.monotonic()
                 self.slot_pages[slot] = pages
+                if self._sp:
+                    # Sequence-parallel prefill: ONE forward with the
+                    # sequence sharded over the mesh "seq" axis (ring
+                    # attention over ICI) instead of serial chunks —
+                    # SURVEY §5 long-context row.
+                    self._prefill_sp(req, slot, n, core)
+                    return True
                 # The row stays OFF the shared page table until the final
                 # chunk installs the slot: interleaved decode steps write
                 # every slot's position through self.page_table, and a

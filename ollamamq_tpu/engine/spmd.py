@@ -149,6 +149,12 @@ class SPMDEngine:
                         "yet (the worker replay protocol carries no replica "
                         "ordinal); use dp on single-host deployments"
                     )
+                if self.ecfg.sp > 1:
+                    raise NotImplementedError(
+                        "sequence-parallel prefill under --spmd is not "
+                        "supported yet (no OP_PREFILL_SP in the worker "
+                        "protocol); use sp on single-host deployments"
+                    )
                 if self._running and jax.process_count() > 1:
                     raise NotImplementedError(
                         "runtime model load (/api/pull) is not supported "

@@ -27,7 +27,7 @@ from ollamamq_tpu.ops.attention import (
     causal_attention,
     bidirectional_attention,
     flat_slot_indices,
-    paged_chunk_attention,
+    paged_chunk_attention_blockwise,
     paged_decode_attention,
 )
 from ollamamq_tpu.ops.rope import apply_rope
@@ -195,7 +195,9 @@ def forward_prefill_chunk(
             nonlocal kc, vc
             kc = kc.at[slots].set(k)
             vc = vc.at[slots].set(v)
-            return paged_chunk_attention(
+            # Block-wise online-softmax walk over real pages only — HBM
+            # reads scale with the actual prefix length, not max context.
+            return paged_chunk_attention_blockwise(
                 q, kc, vc, page_table, start, chunk_lens, page_size
             )
 

@@ -228,6 +228,43 @@ def test_chunked_prefill_ragged_last_chunk(tiny_cfg, tiny_params):
     )
 
 
+def test_blockwise_chunk_attention_matches_full_gather():
+    """paged_chunk_attention_blockwise (dynamic block walk, online softmax)
+    == paged_chunk_attention (full padded gather) on ragged paged batches."""
+    from ollamamq_tpu.ops.attention import (
+        paged_chunk_attention,
+        paged_chunk_attention_blockwise,
+    )
+
+    rng = np.random.default_rng(3)
+    B, C, H, Hk, hd, ps, MP = 3, 8, 4, 2, 16, 4, 12
+    S = 64 * ps
+    q = jnp.asarray(rng.normal(size=(B, C, H, hd)), jnp.float32)
+    kc = jnp.asarray(rng.normal(size=(S, Hk, hd)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(S, Hk, hd)), jnp.float32)
+    # Distinct pages per sequence; tables longer than any sequence needs.
+    pt = jnp.asarray(
+        rng.permutation(64 - 1)[: B * MP].reshape(B, MP) + 1, jnp.int32
+    )
+    # Third sequence's context reaches the LAST page (end=48 == MP*ps), so
+    # the final partial block is exercised when block_pages doesn't divide MP.
+    start = jnp.asarray([0, 9, 44], jnp.int32)
+    chunk_lens = jnp.asarray([8, 5, 4], jnp.int32)  # ragged
+    ref = paged_chunk_attention(q, kc, vc, pt, start, chunk_lens, ps)
+    # block_pages=5 does NOT divide MP=12: the final partial block must not
+    # relabel or double-count pages (clamped-slice regression).
+    for bp in (2, 5):
+        blk = paged_chunk_attention_blockwise(
+            q, kc, vc, pt, start, chunk_lens, ps, block_pages=bp
+        )
+        for b in range(B):
+            n = int(chunk_lens[b])
+            np.testing.assert_allclose(
+                np.asarray(blk[b, :n]), np.asarray(ref[b, :n]),
+                rtol=2e-5, atol=2e-5, err_msg=f"block_pages={bp} seq {b}",
+            )
+
+
 def test_apply_penalties_math():
     from ollamamq_tpu.ops.sampling import apply_penalties
 
