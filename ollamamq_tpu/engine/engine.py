@@ -149,6 +149,10 @@ class ModelRuntime:
         self._rng_counter = engine_cfg.seed
         # Sequence-parallel prefill available when the mesh has a seq axis.
         self._sp = mesh is not None and mesh.shape.get("seq", 1) > 1
+        # Set after an unrecoverable step failure; the engine stops stepping
+        # this runtime and rebuilds it (weights reloaded) when the device
+        # answers again.
+        self._failed = False
         # Ragged paged-attention Pallas kernel on TPU; jnp gather fallback
         # elsewhere (and under OLLAMAMQ_NO_PALLAS=1 for A/B benching).
         no_pallas = os.environ.get("OLLAMAMQ_NO_PALLAS", "").lower() not in (
@@ -183,7 +187,8 @@ class ModelRuntime:
     def has_capacity(self) -> bool:
         """Can we take one more request from the scheduler right now?"""
         return (
-            len(self.pending_prefill) < 2 * self.ecfg.max_slots
+            not self._failed
+            and len(self.pending_prefill) < 2 * self.ecfg.max_slots
             and self.free_slots() > 0
             and self.alloc.free_pages >= 2
         )
@@ -840,6 +845,8 @@ class EncoderRuntime:
         self.name = name
         self.cfg = model_cfg
         self.ecfg = engine_cfg
+        self.mesh = mesh
+        self._failed = False
         self.tokenizer = load_tokenizer(checkpoint_path)
         params = weights.load_params(model_cfg, checkpoint_path,
                                      seed=engine_cfg.seed, dtype=dtype)
@@ -857,7 +864,7 @@ class EncoderRuntime:
         self.step_latency_ms = 0.0
 
     def has_capacity(self) -> bool:
-        return len(self.pending) < 4 * self.ecfg.max_slots
+        return not self._failed and len(self.pending) < 4 * self.ecfg.max_slots
 
     def has_work(self) -> bool:
         return bool(self.pending)
@@ -883,6 +890,13 @@ class EncoderRuntime:
 
             self._jits[key] = jax.jit(fn)
         return self._jits[key]
+
+    # Dispatch seam: the SPMD subclass broadcasts (OP_ENCODE, payload) to
+    # worker hosts before issuing the same jit call.
+    def _dispatch_encode(self, B, bucket, tokens, lens):
+        return self._get_jit(B, bucket)(
+            self.params, jnp.asarray(tokens), jnp.asarray(lens)
+        )
 
     def step(self, core: MQCore) -> None:
         """Encode up to 8 pending requests in one padded batch."""
@@ -918,10 +932,7 @@ class EncoderRuntime:
             tokens[i, : len(r.prompt_tokens)] = r.prompt_tokens
             lens[i] = len(r.prompt_tokens)
         t0 = time.monotonic()
-        out = self._get_jit(B, bucket)(
-            self.params, jnp.asarray(tokens), jnp.asarray(lens)
-        )
-        out = np.asarray(out)
+        out = np.asarray(self._dispatch_encode(B, bucket, tokens, lens))
         self.step_latency_ms = (time.monotonic() - t0) * 1e3
         for i, r in enumerate(batch):
             r.embedding = out[i].tolist()
@@ -976,8 +987,9 @@ class ReplicaSet:
     def submit(self, req: Request) -> None:
         """Least-loaded replica wins; ties rotate after the previous pick."""
         eligible = [i for i, r in enumerate(self.replicas) if r.has_capacity()]
-        if not eligible:  # capacity raced away; park on the least loaded
-            eligible = list(range(len(self.replicas)))
+        if not eligible:  # capacity raced away; park on a LIVE least-loaded
+            eligible = [i for i, r in enumerate(self.replicas)
+                        if not r._failed] or list(range(len(self.replicas)))
         best = min(self._load(self.replicas[i]) for i in eligible)
         ties = {i for i in eligible if self._load(self.replicas[i]) == best}
         n = len(self.replicas)
@@ -1032,9 +1044,10 @@ class ReplicaSet:
 class TPUEngine:
     """Engine front: owns the scheduler core, model runtimes, and the loop."""
 
-    # Generative-runtime class; SPMD deployments swap in SPMDModelRuntime
-    # so every device dispatch is broadcast to worker hosts first.
+    # Runtime classes; SPMD deployments swap in SPMD variants so every
+    # device dispatch is broadcast to worker hosts first.
     runtime_class = ModelRuntime
+    encoder_runtime_class = EncoderRuntime
 
     def __init__(
         self,
@@ -1063,6 +1076,15 @@ class TPUEngine:
         self._thread: Optional[threading.Thread] = None
         self.health = None
         self.started_at = time.time()
+        # Failure recovery: runtimes marked failed are rebuilt (weights
+        # reloaded) on this cadence instead of requiring a process restart.
+        self._model_sources: Dict[str, Optional[str]] = {}
+        self._failed_runtimes: List[object] = []
+        self._recovering: set = set()  # id(rt) with a rebuild in flight
+        self._rebuilt: List[tuple] = []  # (dead_rt, fresh_rt) awaiting swap
+        self._rebuilt_lock = threading.Lock()
+        self._last_recover_attempt = 0.0
+        self.recover_interval = 5.0
         models = models if models is not None else {engine_cfg.model: None}
         for name, ckpt in models.items():
             self.load_model(name, ckpt)
@@ -1074,7 +1096,8 @@ class TPUEngine:
             raise KeyError(f"unknown model architecture: {name}")
         if name in self.runtimes:
             return
-        cls = EncoderRuntime if cfg.is_encoder else self.runtime_class
+        self._model_sources[name] = checkpoint_path
+        cls = self.encoder_runtime_class if cfg.is_encoder else self.runtime_class
         if not cfg.is_encoder and self.ecfg.dp > 1 and self.mesh is not None:
             # dp replicas, each on its own slice of the mesh's data axis
             # (a [1, sp, tp] submesh): N params copies + KV pools serving
@@ -1205,10 +1228,15 @@ class TPUEngine:
 
     def resolve_runtime(self, model: str):
         if not model:
-            # No model requested: any generative runtime (reference lets
-            # Unknown-family tasks hit any backend, dispatcher.rs:453-461).
+            # No model requested: any LIVE generative runtime (reference
+            # lets Unknown-family tasks hit any online backend,
+            # dispatcher.rs:453-461 — offline ones are skipped).
             for rt in self.runtimes.values():
-                if isinstance(rt, (ModelRuntime, ReplicaSet)):
+                if isinstance(rt, ReplicaSet) and any(
+                    not r._failed for r in rt.replicas
+                ):
+                    return rt
+                if isinstance(rt, ModelRuntime) and not rt._failed:
                     return rt
             return next(iter(self.runtimes.values()), None)
         key = smart_match(model, self.runtimes.keys())
@@ -1337,9 +1365,16 @@ class TPUEngine:
 
     def _loop(self) -> None:
         while self._running:
+            self._swap_rebuilt()
+            if (self._failed_runtimes
+                    and time.monotonic() - self._last_recover_attempt
+                    > self.recover_interval):
+                self._try_recover()
             self._admit()
             did_work = False
             for rt in self._step_targets():
+                if getattr(rt, "_failed", False):
+                    continue
                 try:
                     rt.check_cancellations(self.core)
                     if isinstance(rt, ModelRuntime):
@@ -1368,10 +1403,89 @@ class TPUEngine:
                     # 500 and counts dropped, dispatcher.rs:555-559).
                     log.exception("runtime %s step failed", rt.name)
                     self._fail_runtime(rt, "engine step failed")
+                    rt._failed = True
+                    # Drop the dead runtime's device buffers NOW: the HBM
+                    # must be free before the replacement loads, or a
+                    # large model could never recover (params + KV would
+                    # be resident twice).
+                    rt.params = None
+                    if hasattr(rt, "kc"):
+                        rt.kc = rt.vc = None
+                    self._failed_runtimes.append(rt)
                     did_work = True
             if not did_work:
                 with self._cond:
                     self._cond.wait(timeout=0.05)
+
+    def _try_recover(self) -> None:
+        """Kick off background rebuilds of failed runtimes. The reference's
+        recovery story is backends re-entering rotation when the health
+        probe succeeds (dispatcher.rs:373-377); here re-entering rotation
+        means a fresh runtime (weights reloaded), since the old one's
+        device state is gone. The reload runs OFF the engine thread so
+        healthy runtimes keep serving; _swap_rebuilt installs the result."""
+        self._last_recover_attempt = time.monotonic()
+        if jax.process_count() > 1:
+            # SPMD workers replay broadcast dispatches against their own KV
+            # state; rebuilding only the primary's runtime would desync
+            # them. Multi-host recovery needs a reload opcode — until then,
+            # leave the runtime failed (operator restarts the pod).
+            return
+        for rt in list(self._failed_runtimes):
+            if id(rt) in self._recovering:
+                continue
+            self._recovering.add(id(rt))
+            threading.Thread(
+                target=self._rebuild_runtime, args=(rt,),
+                name=f"recover-{rt.name}", daemon=True,
+            ).start()
+
+    def _rebuild_runtime(self, rt) -> None:
+        """(background thread) Build a replacement runtime; post it for the
+        engine thread to swap in."""
+        try:
+            fresh = type(rt)(
+                rt.name, rt.cfg, self.ecfg, mesh=rt.mesh,
+                checkpoint_path=self._model_sources.get(rt.name),
+                dtype=self.dtype,
+            )
+        except Exception:
+            log.exception(
+                "recovery reload of %s failed; retrying in %.0fs",
+                rt.name, self.recover_interval,
+            )
+            self._recovering.discard(id(rt))  # next interval retries
+            return
+        with self._rebuilt_lock:
+            self._rebuilt.append((rt, fresh))
+        self.notify()
+
+    def _swap_rebuilt(self) -> None:
+        """(engine thread) Install finished rebuilds and hand over any
+        requests that raced into the dead runtime between failure and
+        swap."""
+        with self._rebuilt_lock:
+            if not self._rebuilt:
+                return
+            items, self._rebuilt = self._rebuilt, []
+        for rt, fresh in items:
+            if hasattr(rt, "spmd_index"):
+                fresh.spmd_index = rt.spmd_index
+            cur = self.runtimes.get(rt.name)
+            if isinstance(cur, ReplicaSet) and rt in cur.replicas:
+                cur.replicas[cur.replicas.index(rt)] = fresh
+            elif cur is rt:
+                self.runtimes[rt.name] = fresh
+            # else: evicted while failed — drop the rebuild silently.
+            for attr in ("pending_prefill", "chunking", "pending"):
+                q = getattr(rt, attr, None)
+                while q:
+                    fresh.submit(q.popleft())  # restart from scratch
+            self._failed_runtimes.remove(rt)
+            self._recovering.discard(id(rt))
+            log.warning("runtime %s recovered: weights reloaded, serving "
+                        "resumes", rt.name)
+            self.notify()
 
     def _fail_runtime(self, rt, msg: str) -> None:
         """Fail all requests held by a runtime after an unrecoverable error."""

@@ -16,11 +16,12 @@ key) — so both sides always issue matching collectives. Workers sit in
 local shards. Every value feeding the computation is broadcast, never
 recomputed locally, so all hosts trace and execute identical steps.
 
-Opcode header (int32[4]: [op, a, b, _]):
+Opcode header (int32[4]: [op, a, b, model_ordinal]):
     OP_SHUTDOWN = 0              -> workers exit (no payload)
     OP_PREFILL  = 1, a=bucket, b=B
     OP_CHUNK    = 2, a=chunk_size
     OP_DECODE   = 3, a=k_steps
+    OP_ENCODE   = 4, a=B, b=bucket (embedding batch forward, stateless)
 """
 
 from __future__ import annotations
@@ -34,7 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from ollamamq_tpu.config import EngineConfig
-from ollamamq_tpu.engine.engine import ModelRuntime
+from ollamamq_tpu.engine.engine import EncoderRuntime, ModelRuntime
 
 log = logging.getLogger("ollamamq.spmd")
 
@@ -42,6 +43,7 @@ OP_SHUTDOWN = 0
 OP_PREFILL = 1
 OP_CHUNK = 2
 OP_DECODE = 3
+OP_ENCODE = 4
 
 KEY_SHAPE = (2,)  # raw uint32 threefry key data
 
@@ -123,6 +125,22 @@ class SPMDModelRuntime(ModelRuntime):
             pres, freq, seeds, key
         )
 
+class SPMDEncoderRuntime(EncoderRuntime):
+    """EncoderRuntime whose batch-encode dispatches are mirrored on every
+    host (OP_ENCODE), so embedding models serve under --spmd too."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._spmd = jax.process_count() > 1
+        self.spmd_index = 0
+
+    def _dispatch_encode(self, B, bucket, tokens, lens):
+        if self._spmd:
+            _bcast(np.asarray([OP_ENCODE, B, bucket, self.spmd_index], np.int32))
+            _bcast((np.asarray(tokens, np.int32), np.asarray(lens, np.int32)))
+        return super()._dispatch_encode(B, bucket, tokens, lens)
+
+
 class SPMDEngine:
     """Factory + lifecycle glue for the primary host: a TPUEngine whose
     generative runtimes broadcast their dispatches, rejecting what the
@@ -133,16 +151,9 @@ class SPMDEngine:
 
         class _Engine(TPUEngine):
             runtime_class = SPMDModelRuntime
+            encoder_runtime_class = SPMDEncoderRuntime
 
             def load_model(self, name, checkpoint_path=None):
-                from ollamamq_tpu.config import get_model_config
-
-                cfg = get_model_config(name)
-                if cfg is not None and cfg.is_encoder:
-                    raise NotImplementedError(
-                        "embedding models are not supported under --spmd yet "
-                        "(no OP_ENCODE in the worker protocol)"
-                    )
                 if self.ecfg.dp > 1:
                     raise NotImplementedError(
                         "dp replica serving under --spmd is not supported "
@@ -162,7 +173,7 @@ class SPMDEngine:
                     )
                 super().load_model(name, checkpoint_path)
                 rt = self.runtimes.get(name)
-                if isinstance(rt, SPMDModelRuntime):
+                if isinstance(rt, (SPMDModelRuntime, SPMDEncoderRuntime)):
                     rt.spmd_index = list(self.runtimes).index(name)
 
             def stop(self):
@@ -191,11 +202,12 @@ def run_worker(
     runtimes = []
     for name, ckpt in models.items():
         cfg = get_model_config(name)
-        if cfg is None or cfg.is_encoder:
+        if cfg is None:
             raise ValueError(f"model {name} not replayable under SPMD")
+        cls = SPMDEncoderRuntime if cfg.is_encoder else SPMDModelRuntime
         runtimes.append(
-            SPMDModelRuntime(name, cfg, engine_cfg, mesh=mesh,
-                             checkpoint_path=ckpt, dtype=dtype)
+            cls(name, cfg, engine_cfg, mesh=mesh,
+                checkpoint_path=ckpt, dtype=dtype)
         )
     steps = 0
     S = engine_cfg.max_slots
@@ -259,6 +271,12 @@ def run_worker(
                     rt, k_steps, tokens, positions, active, pt, temp, tk,
                     tp, pen, pres, freq, seeds, key
                 )
+            elif op == OP_ENCODE:
+                B, bucket = int(header[1]), int(header[2])
+                tokens, lens = _bcast((
+                    np.zeros((B, bucket), np.int32), np.zeros((B,), np.int32),
+                ))
+                EncoderRuntime._dispatch_encode(rt, B, bucket, tokens, lens)
             else:
                 log.error("unknown opcode %d; shutting down", op)
                 break

@@ -454,6 +454,10 @@ class Server:
         if not stream:
             try:
                 await do_pull()
+            except NotImplementedError as e:
+                # Deliberate deployment-mode gate (e.g. runtime pull under
+                # --spmd), not a load failure.
+                raise ApiError(501, str(e))
             except Exception as e:
                 raise ApiError(500, f"failed to load {name}: {e}")
             return web.json_response({"status": "success"})

@@ -113,3 +113,74 @@ def test_admin_mutations_race_traffic():
                 eng.stop()
 
     asyncio.run(main())
+
+
+def test_runtime_recovers_after_step_failure():
+    """Failure recovery beyond fail-everything (VERDICT r1 item 10): inject
+    a failing decode dispatch -> in-flight requests error; the engine
+    rebuilds the runtime (weights reloaded) and subsequent requests succeed
+    without a process restart."""
+    import time
+
+    from ollamamq_tpu.engine.engine import TPUEngine
+    from ollamamq_tpu.engine.request import Request
+    from ollamamq_tpu.ops.sampling import SamplingParams
+
+    eng = TPUEngine(
+        EngineConfig(model="test-tiny", max_slots=4, num_pages=64, page_size=8,
+                     max_pages_per_seq=16, prefill_buckets=(16, 32, 64),
+                     max_new_tokens=8, decode_steps_per_iter=2),
+        blocklist_path=None,
+    )
+    eng.recover_interval = 0.2
+    eng.start()
+    try:
+        rt = eng.runtimes["test-tiny"]
+        tok = rt.tokenizer
+
+        def boom(*a, **kw):
+            raise RuntimeError("injected device failure")
+
+        rt._dispatch_decode = boom
+
+        def run(user):
+            rid = eng.core.enqueue(user, "", "test-tiny")
+            req = Request(rid, user, "test-tiny", tok.encode("hello"),
+                          SamplingParams(max_tokens=4))
+            eng.submit(req)
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                item = req.stream.get(timeout=0.2)
+                if item and item.kind in ("done", "error"):
+                    return item
+            raise TimeoutError(user)
+
+        item = run("victim")
+        assert item.kind == "error" and "engine step failed" in item.error
+        assert rt._failed and not rt.has_capacity()
+
+        # Enqueue while the runtime is STILL failed: the request must wait
+        # in queue ("stuck in queue" semantics), not error.
+        rid = eng.core.enqueue("survivor", "", "test-tiny")
+        sreq = Request(rid, "survivor", "test-tiny", tok.encode("hello"),
+                       SamplingParams(max_tokens=4))
+        eng.submit(sreq)
+
+        # The engine swaps in a fresh runtime on its recovery cadence.
+        deadline = time.monotonic() + 60
+        while eng.runtimes["test-tiny"] is rt and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert eng.runtimes["test-tiny"] is not rt, "runtime never rebuilt"
+
+        deadline = time.monotonic() + 120
+        item = None
+        while time.monotonic() < deadline:
+            item = sreq.stream.get(timeout=0.2)
+            if item and item.kind in ("done", "error"):
+                break
+        assert item and item.kind == "done", getattr(item, "error", None)
+        snap = eng.core.snapshot()
+        assert snap["users"]["survivor"]["processed"] == 1
+        assert snap["users"]["victim"]["dropped"] == 1
+    finally:
+        eng.stop()

@@ -32,29 +32,47 @@ ecfg = EngineConfig(model="test-tiny", max_slots=2, num_pages=32, page_size=8,
                     decode_steps_per_iter=2)
 mcfg = MODEL_CONFIGS["test-tiny"]
 
+MODELS = {"test-tiny": None, "test-tiny-embed": None}
+
 if pid == 0:
     from ollamamq_tpu.engine.spmd import SPMDEngine
     from ollamamq_tpu.ops.sampling import SamplingParams
 
-    eng = SPMDEngine(ecfg, models={"test-tiny": None}, blocklist_path=None,
+    eng = SPMDEngine(ecfg, models=MODELS, blocklist_path=None,
                      mesh=mesh, dtype=jnp.float32)
     eng.start()
+    import time
+
+    def wait(req, budget=300):
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            item = req.stream.get(timeout=0.5)
+            if item and item.kind in ("done", "error"):
+                return item
+        return None
+
     tok = eng.runtimes["test-tiny"].tokenizer
     req = eng.enqueue_request("u", "", "test-tiny",
                               prompt_tokens=tok.encode("spmd check"),
                               sampling=SamplingParams(max_tokens=6))
-    import time
-    deadline = time.monotonic() + 300
-    while time.monotonic() < deadline:
-        item = req.stream.get(timeout=0.5)
-        if item and item.kind in ("done", "error"):
-            break
+    wait(req)
+    # Embedding request across both hosts (OP_ENCODE replay).
+    etok = eng.runtimes["test-tiny-embed"].tokenizer
+    ereq = eng.enqueue_request("u", "", "test-tiny-embed",
+                               prompt_tokens=etok.encode("embed me"),
+                               sampling=SamplingParams(), kind="embed")
+    eitem = wait(ereq)
     eng.stop()  # also releases workers (single shutdown broadcast)
-    print("RESULT " + json.dumps({"tokens": req.generated_ids}), flush=True)
+    print("RESULT " + json.dumps({
+        "tokens": req.generated_ids,
+        "embed_ok": bool(eitem and eitem.kind == "done"),
+        "embed_dim": len(ereq.embedding or []),
+        "embed_head": (ereq.embedding or [0.0, 0.0])[:2],
+    }), flush=True)
 else:
     from ollamamq_tpu.engine.spmd import run_worker
 
-    steps = run_worker({"test-tiny": None}, ecfg, mesh, dtype=jnp.float32)
+    steps = run_worker(MODELS, ecfg, mesh, dtype=jnp.float32)
     print("RESULT " + json.dumps({"steps": steps}), flush=True)
 """
 
@@ -97,8 +115,9 @@ def test_spmd_two_process_serving(tmp_path):
     worker = json.loads(
         [l for l in outs[1].splitlines() if l.startswith("RESULT ")][0][7:]
     )
-    assert worker["steps"] >= 2  # prefill + at least one decode dispatch
+    assert worker["steps"] >= 3  # prefill + decode(s) + encode dispatch
     assert len(primary["tokens"]) >= 1
+    assert primary["embed_ok"] and primary["embed_dim"] > 0
 
     # Single-process reference with the same seed/config must match exactly.
     from ollamamq_tpu.config import EngineConfig
@@ -112,19 +131,35 @@ def test_spmd_two_process_serving(tmp_path):
         EngineConfig(model="test-tiny", max_slots=2, num_pages=32, page_size=8,
                      max_pages_per_seq=8, prefill_buckets=(16,),
                      decode_steps_per_iter=2),
-        models={"test-tiny": None}, blocklist_path=None, dtype=jnp.float32,
+        models={"test-tiny": None, "test-tiny-embed": None},
+        blocklist_path=None, dtype=jnp.float32,
     )
     eng.start()
     try:
         tok = eng.runtimes["test-tiny"].tokenizer
+
+        def wait(req, budget=120):
+            deadline = time.monotonic() + budget
+            while time.monotonic() < deadline:
+                item = req.stream.get(timeout=0.5)
+                if item and item.kind in ("done", "error"):
+                    return item
+
         req = eng.enqueue_request("u", "", "test-tiny",
                                   prompt_tokens=tok.encode("spmd check"),
                                   sampling=SamplingParams(max_tokens=6))
-        deadline = time.monotonic() + 120
-        while time.monotonic() < deadline:
-            item = req.stream.get(timeout=0.5)
-            if item and item.kind in ("done", "error"):
-                break
+        wait(req)
         assert req.generated_ids == primary["tokens"]
+        etok = eng.runtimes["test-tiny-embed"].tokenizer
+        ereq = eng.enqueue_request("u", "", "test-tiny-embed",
+                                   prompt_tokens=etok.encode("embed me"),
+                                   sampling=SamplingParams(), kind="embed")
+        wait(ereq)
+        assert len(ereq.embedding) == primary["embed_dim"]
+        import numpy as np
+
+        np.testing.assert_allclose(
+            ereq.embedding[:2], primary["embed_head"], rtol=1e-4, atol=1e-5
+        )
     finally:
         eng.stop()
