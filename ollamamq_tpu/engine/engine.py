@@ -102,6 +102,19 @@ class ModelRuntime:
                 model_cfg, checkpoint_path, seed=engine_cfg.seed, dtype=dtype
             )
         )
+        tp_axis = mesh.shape.get("tensor", 1) if mesh is not None else 1
+        if tp_axis > model_cfg.num_kv_heads:
+            # Replicated-group KV sharding (e.g. qwen2.5's 4 KV heads on
+            # tp=8): duplicate each KV head so every shard owns one copy.
+            # validate_tp_for_model already guaranteed divisibility.
+            r = tp_axis // model_cfg.num_kv_heads
+            params = weights.replicate_kv_heads(params, model_cfg, r)
+            import dataclasses as _dc
+
+            model_cfg = _dc.replace(model_cfg, num_kv_heads=tp_axis)
+            self.cfg = model_cfg
+            log.info("replicated KV heads x%d for tp=%d (%s)", r, tp_axis,
+                     name)
         kv_sharding = None
         if mesh is not None:
             from jax.sharding import NamedSharding
@@ -163,6 +176,9 @@ class ModelRuntime:
             if jax.default_backend() == "tpu" and not no_pallas
             else "jnp"
         )
+        # Flips true after the first successful decode dispatch; until then
+        # a pallas failure falls back to jnp instead of failing the runtime.
+        self._pallas_proven = False
 
         # Telemetry.
         self.step_latency_ms = 0.0
@@ -766,6 +782,35 @@ class ModelRuntime:
         active_mask = np.asarray(
             [1 if r is not None else 0 for r in self.slot_req], np.int32
         )
+
+        if (self.attn_impl == "pallas" and not self._pallas_proven
+                and jax.process_count() == 1):
+            # Probe the unproven Pallas kernel with an AOT compile BEFORE
+            # the real dispatch: lower().compile() executes nothing and
+            # donates nothing, so a Mosaic compile failure flips us to the
+            # jnp reference attention with the KV state untouched. A kernel
+            # that compiles but faults at runtime goes down the normal
+            # _fail_runtime -> rebuild path like any other device error.
+            try:
+                self._get_decode_jit(k_steps).lower(
+                    self.params, jnp.asarray(self.last_tokens),
+                    jnp.asarray(self.seq_lens), self.kc, self.vc,
+                    self.recent, jnp.asarray(active_mask),
+                    jnp.asarray(self.page_table), jnp.asarray(self.temp),
+                    jnp.asarray(self.top_k), jnp.asarray(self.top_p),
+                    jnp.asarray(self.rep_pen), jnp.asarray(self.pres_pen),
+                    jnp.asarray(self.freq_pen), jnp.asarray(self.seeds),
+                    jax.random.PRNGKey(0),
+                ).compile()
+                self._pallas_proven = True
+            except Exception:
+                log.exception(
+                    "pallas decode kernel failed to compile; serving falls "
+                    "back to jnp attention for runtime %s", self.name,
+                )
+                self.attn_impl = "jnp"
+                self._decode_jits.clear()
+
         toks, self.kc, self.vc, self.recent = self._dispatch_decode(
             k_steps, self.last_tokens,
             self.seq_lens,  # position of the incoming token

@@ -121,3 +121,35 @@ def load_params(
             return load_safetensors(cfg, checkpoint_path, dtype=dtype)
         return load_orbax(checkpoint_path)
     return init_random(cfg, seed=seed, dtype=dtype)
+
+
+def replicate_kv_heads(params: dict, cfg, r: int) -> dict:
+    """Duplicate each KV head r times (consecutively) so num_kv_heads grows
+    to r * cfg.num_kv_heads — the replicated-group sharding for
+    tp > num_kv_heads: every tensor-parallel shard then owns exactly one
+    (duplicated) KV head. Numerics are exactly preserved: q head i maps to
+    kv' head i // (H/Hk') and kv'[j] == kv[j // r], which composes to the
+    original i // (H/Hk) assignment. Costs r x KV-cache memory."""
+    import jax.numpy as jnp
+
+    Hk, hd = cfg.num_kv_heads, cfg.head_dim
+
+    def rep_w(w):  # [L, d, Hk*hd] -> [L, d, r*Hk*hd]
+        L, d, _ = w.shape
+        return jnp.repeat(
+            w.reshape(L, d, Hk, hd), r, axis=2
+        ).reshape(L, d, r * Hk * hd)
+
+    def rep_b(b):  # [L, Hk*hd] -> [L, r*Hk*hd]
+        L, _ = b.shape
+        return jnp.repeat(b.reshape(L, Hk, hd), r, axis=1).reshape(L, -1)
+
+    layers = dict(params["layers"])
+    layers["wk"] = rep_w(layers["wk"])
+    layers["wv"] = rep_w(layers["wv"])
+    if "bk" in layers:
+        layers["bk"] = rep_b(layers["bk"])
+        layers["bv"] = rep_b(layers["bv"])
+    out = dict(params)
+    out["layers"] = layers
+    return out

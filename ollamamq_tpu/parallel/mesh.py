@@ -62,16 +62,16 @@ def mesh_axis_size(mesh: Mesh, axis: str) -> int:
 def validate_tp_for_model(tp: int, num_kv_heads: int, num_heads: int) -> None:
     """TP must divide the head counts so shards stay aligned (MXU tiling).
 
-    tp > num_kv_heads is rejected outright: the param/KV sharding specs
-    (parallel/sharding.py) shard the kv_heads dim over the full tensor axis
-    with no replication grouping, so a 4-wide kv_heads dim on tp=8 would
-    mislayout at runtime. Grouped-replica KV sharding is future work; until
-    it exists, advertising it would be a lie (ADVICE r1)."""
+    tp > num_kv_heads is allowed when tp % num_kv_heads == 0: the runtime
+    duplicates each KV head tp/num_kv_heads times at load
+    (weights.replicate_kv_heads) so every shard owns one copy — the
+    replicated-group sharding, at the cost of that factor in KV-cache
+    memory (e.g. qwen2.5's 4 KV heads on tp=8 cost 2x KV HBM)."""
     if num_heads % tp != 0:
         raise ValueError(f"num_heads={num_heads} not divisible by tp={tp}")
-    if num_kv_heads % tp != 0:
+    if num_kv_heads % tp != 0 and tp % num_kv_heads != 0:
         raise ValueError(
-            f"num_kv_heads={num_kv_heads} not divisible by tp={tp}: "
-            "grouped/replicated KV sharding for tp > kv_heads is not "
-            "implemented — use tp <= num_kv_heads"
+            f"num_kv_heads={num_kv_heads} incompatible with tp={tp}: "
+            "needs kv_heads % tp == 0 (sharded) or tp % kv_heads == 0 "
+            "(replicated groups)"
         )

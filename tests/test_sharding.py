@@ -64,3 +64,52 @@ def test_tp_forward_matches_single_device(tiny_cfg, tiny_params):
     np.testing.assert_allclose(
         np.asarray(ref_kc), np.asarray(tp_kc), rtol=1e-4, atol=1e-4
     )
+
+
+def test_tp_over_kv_heads_replicated_groups():
+    """tp=8 over a 4-KV-head model (qwen2.5 shape): KV heads replicate so
+    every shard owns one copy, and generation matches tp=1 exactly
+    (duplicated heads are numerically transparent)."""
+    import time
+
+    from ollamamq_tpu.config import EngineConfig
+    from ollamamq_tpu.engine.engine import TPUEngine
+    from ollamamq_tpu.engine.request import Request
+    from ollamamq_tpu.ops.sampling import SamplingParams
+
+    def cfg(tp):
+        return EngineConfig(model="test-tiny-gqa", max_slots=2, num_pages=64,
+                            page_size=8, max_pages_per_seq=16,
+                            prefill_buckets=(16, 32), max_new_tokens=6,
+                            decode_steps_per_iter=2, tp=tp)
+
+    def run(eng, user):
+        rt = eng.runtimes["test-tiny-gqa"]
+        tok = rt.tokenizer
+        rid = eng.core.enqueue(user, "", "test-tiny-gqa")
+        req = Request(rid, user, "test-tiny-gqa", tok.encode("grouped kv"),
+                      SamplingParams(max_tokens=5))
+        eng.submit(req)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            item = req.stream.get(timeout=0.2)
+            if item and item.kind in ("done", "error"):
+                assert item.kind == "done", getattr(item, "error", None)
+                return req.generated_ids
+        raise TimeoutError
+
+    eng8 = TPUEngine(cfg(8), blocklist_path=None)
+    eng1 = TPUEngine(cfg(1), blocklist_path=None)
+    eng8.start()
+    eng1.start()
+    try:
+        rt8 = eng8.runtimes["test-tiny-gqa"]
+        assert rt8.cfg.num_kv_heads == 8  # 4 heads replicated x2
+        # KV cache sharded over all 8 devices, one (duplicated) head each.
+        assert len(rt8.kc.sharding.device_set) == 8
+        ids8 = run(eng8, "tp8")
+        ids1 = run(eng1, "tp1")
+        assert ids8 == ids1, f"{ids8} != {ids1}"
+    finally:
+        eng8.stop()
+        eng1.stop()
