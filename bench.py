@@ -48,6 +48,9 @@ def main() -> int:
     p.add_argument("--long-prompt", type=int, default=0,
                    help="if >0, also time chunked prefill of a prompt this "
                         "long (should exceed the largest bucket)")
+    p.add_argument("--embed-model", default="",
+                   help="if set, also measure embedding batch throughput "
+                        "on this encoder model (BASELINE config 3)")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU platform (smoke-testing the harness)")
     p.add_argument("--init-timeout", type=float, default=300.0,
@@ -70,6 +73,13 @@ def main() -> int:
     if model_cfg is None:
         _emit_error(f"unknown model '{args.model}'", known=sorted(MODEL_CONFIGS))
         return 2
+    emodel_cfg = None
+    if args.embed_model:
+        emodel_cfg = get_model_config(args.embed_model)
+        if emodel_cfg is None or not emodel_cfg.is_encoder:
+            _emit_error(f"--embed-model '{args.embed_model}' is not an "
+                        "encoder architecture")
+            return 2
 
     if args.cpu:
         from ollamamq_tpu.platform_force import force_cpu
@@ -230,6 +240,52 @@ def main() -> int:
     tokens = active * done_steps
     tok_per_s = tokens / elapsed if elapsed > 0 else 0.0
 
+    # Embedding throughput (BASELINE config 3: /api/embed batches). A
+    # failure here (second model's weights may not fit next to the decode
+    # model) must not discard the decode numbers already measured — report
+    # it in-band instead. A watchdog covers the second weight upload, which
+    # can hang the same way initial init can.
+    embed_tok_per_s = None
+    embed_error = None
+    if emodel_cfg is not None:
+        embed_done = threading.Event()
+
+        def _embed_watchdog():
+            if not embed_done.wait(args.init_timeout):
+                _emit_error(
+                    f"embed-model init exceeded {args.init_timeout:.0f}s "
+                    "(wedged device?)", phase="embed_init")
+                os._exit(3)
+
+        if args.init_timeout > 0:
+            threading.Thread(target=_embed_watchdog, daemon=True).start()
+        try:
+            from ollamamq_tpu.engine.engine import EncoderRuntime
+
+            ert = EncoderRuntime(args.embed_model, emodel_cfg, ecfg)
+
+            def embed_batch(i0):
+                for i in range(8):
+                    prompt = rng.integers(
+                        3, min(emodel_cfg.vocab_size, 30000), size=64).tolist()
+                    ereq = Request(9000 + i0 + i, "embuser", args.embed_model,
+                                   prompt, SamplingParams(), kind="embed")
+                    ert.pending.append(ereq)
+                ert.step(core)
+
+            embed_batch(0)  # compile
+            n_batches = 8
+            t0 = time.monotonic()
+            for b in range(1, n_batches + 1):
+                embed_batch(b * 10)
+            embed_elapsed = time.monotonic() - t0
+            embed_tok_per_s = n_batches * 8 * 64 / embed_elapsed
+        except Exception as e:
+            embed_error = f"{type(e).__name__}: {e}"
+            print(f"# embed phase failed: {embed_error}", file=sys.stderr)
+        finally:
+            embed_done.set()
+
     result = {
         "metric": "decode_tok_per_s_per_chip",
         "value": round(tok_per_s, 1),
@@ -251,6 +307,12 @@ def main() -> int:
     if long_ms is not None:
         result["long_prompt_len"] = args.long_prompt
         result["long_prefill_ms"] = round(long_ms, 1)
+    if args.embed_model:
+        result["embed_model"] = args.embed_model
+        if embed_tok_per_s is not None:
+            result["embed_tok_per_s"] = round(embed_tok_per_s, 1)
+        if embed_error is not None:
+            result["embed_error"] = embed_error
     print(json.dumps(result), flush=True)
     return 0
 

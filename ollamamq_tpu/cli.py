@@ -61,6 +61,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "scheduler+HTTP and broadcasts step plans; other "
                         "processes replay them (requires jax.distributed "
                         "env vars)")
+    p.add_argument("--cpu", type=int, nargs="?", const=1, default=0,
+                   metavar="N",
+                   help="force the CPU platform with N virtual devices "
+                        "(development / CI; wins over a TPU-registering "
+                        "sitecustomize)")
     return p
 
 
@@ -82,6 +87,11 @@ def main(argv=None) -> int:
     use_tui = not args.no_tui and sys.stdout.isatty()
     setup_logging(use_tui)
     log = logging.getLogger("ollamamq")
+
+    if args.cpu:
+        from ollamamq_tpu.platform_force import force_cpu
+
+        force_cpu(args.cpu)
 
     from ollamamq_tpu.config import EngineConfig
     from ollamamq_tpu.core import Fairness
