@@ -91,7 +91,9 @@ def main(argv=None) -> int:
     if args.cpu:
         from ollamamq_tpu.platform_force import force_cpu
 
-        force_cpu(args.cpu)
+        # check=False: jax.distributed.initialize below must run before the
+        # first backend touch in multi-process deployments.
+        force_cpu(args.cpu, check=False)
 
     from ollamamq_tpu.config import EngineConfig
     from ollamamq_tpu.core import Fairness

@@ -22,6 +22,7 @@ Opcode header (int32[4]: [op, a, b, model_ordinal]):
     OP_CHUNK    = 2, a=chunk_size
     OP_DECODE   = 3, a=k_steps
     OP_ENCODE   = 4, a=B, b=bucket (embedding batch forward, stateless)
+    OP_PREFILL_SP = 5, a=T (sequence-parallel long-prompt prefill)
 """
 
 from __future__ import annotations
@@ -44,6 +45,7 @@ OP_PREFILL = 1
 OP_CHUNK = 2
 OP_DECODE = 3
 OP_ENCODE = 4
+OP_PREFILL_SP = 5
 
 KEY_SHAPE = (2,)  # raw uint32 threefry key data
 
@@ -52,6 +54,47 @@ def _bcast(tree):
     from jax.experimental import multihost_utils
 
     return multihost_utils.broadcast_one_to_all(tree)
+
+
+def payload_spec(op, a, b, S, MP):
+    """[(shape, dtype), ...] for an opcode's broadcast payload — the ONE
+    place the wire order lives. Senders cast their positional values to
+    this spec; workers build a zeros template from it. Broadcast matches
+    on tree structure + shape/dtype, so both sides must agree exactly."""
+
+    def samp(n):  # temp, top_k, top_p, repeat, presence, frequency, seed
+        return [((n,), np.float32), ((n,), np.int32), ((n,), np.float32),
+                ((n,), np.float32), ((n,), np.float32), ((n,), np.float32),
+                ((n,), np.int32)]
+
+    key = [(KEY_SHAPE, np.uint32)]
+    if op == OP_PREFILL:
+        bucket, B = a, b
+        return [((B, bucket), np.int32), ((B,), np.int32), ((B,), np.int32),
+                ((B, MP), np.int32)] + samp(B) + key
+    if op == OP_CHUNK:
+        return [((1, a), np.int32), ((1,), np.int32), ((1,), np.int32),
+                ((1,), np.int32), ((1,), np.int32),
+                ((1, MP), np.int32)] + samp(1) + key
+    if op == OP_DECODE:
+        return [((S,), np.int32), ((S,), np.int32), ((S,), np.int32),
+                ((S, MP), np.int32)] + samp(S) + key
+    if op == OP_PREFILL_SP:
+        return [((1, a), np.int32), ((1,), np.int32), ((1,), np.int32),
+                ((1, MP), np.int32)] + samp(1) + key
+    raise ValueError(f"no payload spec for opcode {op}")
+
+
+def _send(op, a, b, index, values, S, MP):
+    spec = payload_spec(op, a, b, S, MP)
+    assert len(values) == len(spec)
+    _bcast(np.asarray([op, a, b, index], np.int32))
+    _bcast(tuple(np.asarray(v, dt) for v, (_, dt) in zip(values, spec)))
+
+
+def _recv(op, a, b, S, MP):
+    spec = payload_spec(op, a, b, S, MP)
+    return _bcast(tuple(np.zeros(shape, dt) for shape, dt in spec))
 
 
 def broadcast_shutdown() -> None:
@@ -79,14 +122,10 @@ class SPMDModelRuntime(ModelRuntime):
     def _dispatch_prefill(self, bucket, B, tokens, lens, slot_ids, pt_rows,
                           temp, tk, tp, pen, pres, freq, seeds, key):
         if self._spmd:
-            _bcast(np.asarray([OP_PREFILL, bucket, B, self.spmd_index], np.int32))
-            _bcast((np.asarray(tokens, np.int32), np.asarray(lens, np.int32),
-                    np.asarray(slot_ids, np.int32),
-                    np.asarray(pt_rows, np.int32), np.asarray(temp, np.float32),
-                    np.asarray(tk, np.int32), np.asarray(tp, np.float32),
-                    np.asarray(pen, np.float32), np.asarray(pres, np.float32),
-                    np.asarray(freq, np.float32), np.asarray(seeds, np.int32),
-                    np.asarray(key, np.uint32)))
+            _send(OP_PREFILL, bucket, B, self.spmd_index,
+                  (tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen,
+                   pres, freq, seeds, key),
+                  self.ecfg.max_slots, self.ecfg.max_pages_per_seq)
         return super()._dispatch_prefill(
             bucket, B, tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen,
             pres, freq, seeds, key
@@ -95,15 +134,10 @@ class SPMDModelRuntime(ModelRuntime):
     def _dispatch_chunk(self, chunk, tokens, start, cl, slot_id, is_final,
                         pt_row, temp, tk, tp, pen, pres, freq, seeds, key):
         if self._spmd:
-            _bcast(np.asarray([OP_CHUNK, chunk, 0, self.spmd_index], np.int32))
-            _bcast((np.asarray(tokens, np.int32), np.asarray(start, np.int32),
-                    np.asarray(cl, np.int32), np.asarray(slot_id, np.int32),
-                    np.asarray(is_final, np.int32),
-                    np.asarray(pt_row, np.int32),
-                    np.asarray(temp, np.float32), np.asarray(tk, np.int32),
-                    np.asarray(tp, np.float32), np.asarray(pen, np.float32),
-                    np.asarray(pres, np.float32), np.asarray(freq, np.float32),
-                    np.asarray(seeds, np.int32), np.asarray(key, np.uint32)))
+            _send(OP_CHUNK, chunk, 0, self.spmd_index,
+                  (tokens, start, cl, slot_id, is_final, pt_row, temp, tk,
+                   tp, pen, pres, freq, seeds, key),
+                  self.ecfg.max_slots, self.ecfg.max_pages_per_seq)
         return super()._dispatch_chunk(
             chunk, tokens, start, cl, slot_id, is_final, pt_row, temp, tk,
             tp, pen, pres, freq, seeds, key
@@ -112,17 +146,25 @@ class SPMDModelRuntime(ModelRuntime):
     def _dispatch_decode(self, k_steps, tokens, positions, active, pt, temp,
                          tk, tp, pen, pres, freq, seeds, key):
         if self._spmd:
-            _bcast(np.asarray([OP_DECODE, k_steps, 0, self.spmd_index], np.int32))
-            _bcast((np.asarray(tokens, np.int32), np.asarray(positions, np.int32),
-                    np.asarray(active, np.int32),
-                    np.asarray(pt, np.int32), np.asarray(temp, np.float32),
-                    np.asarray(tk, np.int32), np.asarray(tp, np.float32),
-                    np.asarray(pen, np.float32), np.asarray(pres, np.float32),
-                    np.asarray(freq, np.float32), np.asarray(seeds, np.int32),
-                    np.asarray(key, np.uint32)))
+            _send(OP_DECODE, k_steps, 0, self.spmd_index,
+                  (tokens, positions, active, pt, temp, tk, tp, pen, pres,
+                   freq, seeds, key),
+                  self.ecfg.max_slots, self.ecfg.max_pages_per_seq)
         return super()._dispatch_decode(
             k_steps, tokens, positions, active, pt, temp, tk, tp, pen,
             pres, freq, seeds, key
+        )
+
+    def _dispatch_prefill_sp(self, T, tokens, lens, slot_ids, pt_rows,
+                             temp, tk, tp, pen, pres, freq, seeds, key):
+        if self._spmd:
+            _send(OP_PREFILL_SP, T, 0, self.spmd_index,
+                  (tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen,
+                   pres, freq, seeds, key),
+                  self.ecfg.max_slots, self.ecfg.max_pages_per_seq)
+        return super()._dispatch_prefill_sp(
+            T, tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen, pres,
+            freq, seeds, key
         )
 
 class SPMDEncoderRuntime(EncoderRuntime):
@@ -159,12 +201,6 @@ class SPMDEngine:
                         "dp replica serving under --spmd is not supported "
                         "yet (the worker replay protocol carries no replica "
                         "ordinal); use dp on single-host deployments"
-                    )
-                if self.ecfg.sp > 1:
-                    raise NotImplementedError(
-                        "sequence-parallel prefill under --spmd is not "
-                        "supported yet (no OP_PREFILL_SP in the worker "
-                        "protocol); use sp on single-host deployments"
                     )
                 if self._running and jax.process_count() > 1:
                     raise NotImplementedError(
@@ -223,15 +259,7 @@ def run_worker(
             if op == OP_PREFILL:
                 bucket, B = int(header[1]), int(header[2])
                 (tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen, pres,
-                 freq, seeds, key_data) = _bcast((
-                    np.zeros((B, bucket), np.int32), np.zeros((B,), np.int32),
-                    np.zeros((B,), np.int32),
-                    np.zeros((B, MP), np.int32), np.zeros((B,), np.float32),
-                    np.zeros((B,), np.int32), np.ones((B,), np.float32),
-                    np.ones((B,), np.float32), np.zeros((B,), np.float32),
-                    np.zeros((B,), np.float32), np.zeros((B,), np.int32),
-                    np.zeros(KEY_SHAPE, np.uint32),
-                ))
+                 freq, seeds, key_data) = _recv(op, bucket, B, S, MP)
                 key = jnp.asarray(key_data, jnp.uint32)
                 _, rt.kc, rt.vc, rt.recent = ModelRuntime._dispatch_prefill(
                     rt, bucket, B, tokens, lens, slot_ids, pt_rows, temp,
@@ -240,15 +268,7 @@ def run_worker(
             elif op == OP_CHUNK:
                 chunk = int(header[1])
                 (tokens, start, cl, slot_id, is_final, pt_row, temp, tk, tp,
-                 pen, pres, freq, seeds, key_data) = _bcast((
-                    np.zeros((1, chunk), np.int32), np.zeros((1,), np.int32),
-                    np.zeros((1,), np.int32), np.zeros((1,), np.int32),
-                    np.zeros((1,), np.int32), np.zeros((1, MP), np.int32),
-                    np.zeros((1,), np.float32), np.zeros((1,), np.int32),
-                    np.ones((1,), np.float32), np.ones((1,), np.float32),
-                    np.zeros((1,), np.float32), np.zeros((1,), np.float32),
-                    np.zeros((1,), np.int32), np.zeros(KEY_SHAPE, np.uint32),
-                ))
+                 pen, pres, freq, seeds, key_data) = _recv(op, chunk, 0, S, MP)
                 key = jnp.asarray(key_data, jnp.uint32)
                 _, rt.kc, rt.vc, rt.recent = ModelRuntime._dispatch_chunk(
                     rt, chunk, tokens, start, cl, slot_id, is_final, pt_row,
@@ -257,19 +277,20 @@ def run_worker(
             elif op == OP_DECODE:
                 k_steps = int(header[1])
                 (tokens, positions, active, pt, temp, tk, tp, pen, pres,
-                 freq, seeds, key_data) = _bcast((
-                    np.zeros((S,), np.int32), np.zeros((S,), np.int32),
-                    np.zeros((S,), np.int32),
-                    np.zeros((S, MP), np.int32), np.zeros((S,), np.float32),
-                    np.zeros((S,), np.int32), np.ones((S,), np.float32),
-                    np.ones((S,), np.float32), np.zeros((S,), np.float32),
-                    np.zeros((S,), np.float32), np.zeros((S,), np.int32),
-                    np.zeros(KEY_SHAPE, np.uint32),
-                ))
+                 freq, seeds, key_data) = _recv(op, k_steps, 0, S, MP)
                 key = jnp.asarray(key_data, jnp.uint32)
                 _, rt.kc, rt.vc, rt.recent = ModelRuntime._dispatch_decode(
                     rt, k_steps, tokens, positions, active, pt, temp, tk,
                     tp, pen, pres, freq, seeds, key
+                )
+            elif op == OP_PREFILL_SP:
+                T = int(header[1])
+                (tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen, pres,
+                 freq, seeds, key_data) = _recv(op, T, 0, S, MP)
+                key = jnp.asarray(key_data, jnp.uint32)
+                _, rt.kc, rt.vc, rt.recent = ModelRuntime._dispatch_prefill_sp(
+                    rt, T, tokens, lens, slot_ids, pt_rows, temp, tk, tp,
+                    pen, pres, freq, seeds, key
                 )
             elif op == OP_ENCODE:
                 B, bucket = int(header[1]), int(header[2])

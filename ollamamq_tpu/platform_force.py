@@ -16,13 +16,17 @@ import re
 _FLAG = "xla_force_host_platform_device_count"
 
 
-def force_cpu(n_devices: int) -> None:
+def force_cpu(n_devices: int, check: bool = True) -> None:
     """Pin JAX to CPU with at least ``n_devices`` virtual devices.
 
     Call before any jax device/backend touch. Sets the env vars (honoring a
     pre-existing --xla_force_host_platform_device_count only if it is already
     large enough — a stale smaller value is replaced) and jax.config, which
     wins even when a sitecustomize pre-registered a TPU plugin.
+
+    ``check=False`` skips the verifying jax.devices() call — required when
+    jax.distributed.initialize() must still run before the first backend
+    touch (multi-process CPU deployments).
     """
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")
@@ -36,6 +40,8 @@ def force_cpu(n_devices: int) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    if not check:
+        return
     # jax caches backends on first touch; if something initialized the real
     # TPU platform before us, the env/config changes above are silently
     # ignored — fail loudly instead of running "multi-chip CPU" work on it.

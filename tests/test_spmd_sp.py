@@ -1,0 +1,136 @@
+"""SPMD sequence-parallel prefill: 2 CPU processes, mesh seq axis spanning
+both — a long prompt takes the OP_PREFILL_SP broadcast path and the
+generated tokens equal a single-process run."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_SCRIPT = r"""
+import json, os, sys
+pid = int(sys.argv[1]); port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)  # exactly 1 local device per process
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=f"localhost:{port}",
+                           num_processes=2, process_id=pid)
+assert jax.device_count() == 2
+
+from ollamamq_tpu.config import EngineConfig
+from ollamamq_tpu.parallel.mesh import make_mesh
+import jax.numpy as jnp
+
+mesh = make_mesh(dp=1, sp=2, tp=1)
+ecfg = EngineConfig(model="test-tiny", max_slots=2, num_pages=64, page_size=8,
+                    max_pages_per_seq=16, prefill_buckets=(16,),
+                    decode_steps_per_iter=2, sp=2)
+
+if pid == 0:
+    from ollamamq_tpu.engine.spmd import SPMDEngine
+    from ollamamq_tpu.ops.sampling import SamplingParams
+
+    eng = SPMDEngine(ecfg, models={"test-tiny": None}, blocklist_path=None,
+                     mesh=mesh, dtype=jnp.float32)
+    eng.start()
+    rt = eng.runtimes["test-tiny"]
+    assert rt._sp, "seq axis not detected"
+    tok = rt.tokenizer
+    prompt = tok.encode("sequence parallel spmd " * 3)  # ~70 > bucket 16
+    req = eng.enqueue_request("u", "", "test-tiny", prompt_tokens=prompt,
+                              sampling=SamplingParams(max_tokens=5))
+    import time
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        item = req.stream.get(timeout=0.5)
+        if item and item.kind in ("done", "error"):
+            break
+    used_sp = any(isinstance(k, tuple) and k[0] == "sp"
+                  for k in rt._prefill_jits)
+    eng.stop()
+    print("RESULT " + json.dumps({"tokens": req.generated_ids,
+                                  "used_sp": used_sp}), flush=True)
+else:
+    from ollamamq_tpu.engine.spmd import run_worker
+
+    steps = run_worker({"test-tiny": None}, ecfg, mesh, dtype=jnp.float32)
+    print("RESULT " + json.dumps({"steps": steps}), flush=True)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_spmd_sp_prefill_two_processes(tmp_path):
+    port = _free_port()
+    script = tmp_path / "spmd_sp_child.py"
+    script.write_text(_SCRIPT)
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen([sys.executable, str(script), str(pid), str(port)],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True, env=env)
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=540)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("SPMD SP processes hung")
+        assert p.returncode == 0, f"child failed:\n{err[-2000:]}"
+        outs.append(out)
+
+    primary = json.loads(
+        [l for l in outs[0].splitlines() if l.startswith("RESULT ")][0][7:]
+    )
+    worker = json.loads(
+        [l for l in outs[1].splitlines() if l.startswith("RESULT ")][0][7:]
+    )
+    assert primary["used_sp"], "long prompt did not take the SP path"
+    assert worker["steps"] >= 2  # sp prefill + decode dispatches
+    assert len(primary["tokens"]) >= 1
+
+    # Single-process reference (same seed/config) must match exactly.
+    import time
+
+    import jax.numpy as jnp
+
+    from ollamamq_tpu.config import EngineConfig
+    from ollamamq_tpu.engine.engine import TPUEngine
+    from ollamamq_tpu.ops.sampling import SamplingParams
+
+    eng = TPUEngine(
+        EngineConfig(model="test-tiny", max_slots=2, num_pages=64,
+                     page_size=8, max_pages_per_seq=16, prefill_buckets=(16,),
+                     decode_steps_per_iter=2),
+        models={"test-tiny": None}, blocklist_path=None, dtype=jnp.float32,
+    )
+    eng.start()
+    try:
+        tok = eng.runtimes["test-tiny"].tokenizer
+        req = eng.enqueue_request(
+            "u", "", "test-tiny",
+            prompt_tokens=tok.encode("sequence parallel spmd " * 3),
+            sampling=SamplingParams(max_tokens=5))
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            item = req.stream.get(timeout=0.5)
+            if item and item.kind in ("done", "error"):
+                break
+        assert req.generated_ids == primary["tokens"]
+    finally:
+        eng.stop()
