@@ -91,9 +91,12 @@ def main(argv=None) -> int:
     if args.cpu:
         from ollamamq_tpu.platform_force import force_cpu
 
-        # check=False: jax.distributed.initialize below must run before the
-        # first backend touch in multi-process deployments.
-        force_cpu(args.cpu, check=False)
+        # Multi-process only: defer the backend-touch verification, since
+        # jax.distributed.initialize below must run before the first
+        # backend touch. Single-process keeps the loud platform check.
+        multiproc = bool(os.environ.get("JAX_COORDINATOR_ADDRESS")
+                         or os.environ.get("JAX_NUM_PROCESSES"))
+        force_cpu(args.cpu, check=not multiproc)
 
     from ollamamq_tpu.config import EngineConfig
     from ollamamq_tpu.core import Fairness

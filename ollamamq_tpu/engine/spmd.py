@@ -82,14 +82,24 @@ def payload_spec(op, a, b, S, MP):
     if op == OP_PREFILL_SP:
         return [((1, a), np.int32), ((1,), np.int32), ((1,), np.int32),
                 ((1, MP), np.int32)] + samp(1) + key
+    if op == OP_ENCODE:
+        B, bucket = a, b
+        return [((B, bucket), np.int32), ((B,), np.int32)]
     raise ValueError(f"no payload spec for opcode {op}")
 
 
 def _send(op, a, b, index, values, S, MP):
     spec = payload_spec(op, a, b, S, MP)
     assert len(values) == len(spec)
+    cast = []
+    for v, (shape, dt) in zip(values, spec):
+        v = np.asarray(v, dt)
+        # Shape drift would desync the broadcast tree across hosts with an
+        # opaque cross-host error; fail at the send site instead.
+        assert v.shape == shape, (op, v.shape, shape)
+        cast.append(v)
     _bcast(np.asarray([op, a, b, index], np.int32))
-    _bcast(tuple(np.asarray(v, dt) for v, (_, dt) in zip(values, spec)))
+    _bcast(tuple(cast))
 
 
 def _recv(op, a, b, S, MP):
@@ -178,8 +188,8 @@ class SPMDEncoderRuntime(EncoderRuntime):
 
     def _dispatch_encode(self, B, bucket, tokens, lens):
         if self._spmd:
-            _bcast(np.asarray([OP_ENCODE, B, bucket, self.spmd_index], np.int32))
-            _bcast((np.asarray(tokens, np.int32), np.asarray(lens, np.int32)))
+            _send(OP_ENCODE, B, bucket, self.spmd_index, (tokens, lens),
+                  self.ecfg.max_slots, self.ecfg.max_pages_per_seq)
         return super()._dispatch_encode(B, bucket, tokens, lens)
 
 
@@ -294,9 +304,7 @@ def run_worker(
                 )
             elif op == OP_ENCODE:
                 B, bucket = int(header[1]), int(header[2])
-                tokens, lens = _bcast((
-                    np.zeros((B, bucket), np.int32), np.zeros((B,), np.int32),
-                ))
+                tokens, lens = _recv(op, B, bucket, S, MP)
                 EncoderRuntime._dispatch_encode(rt, B, bucket, tokens, lens)
             else:
                 log.error("unknown opcode %d; shutting down", op)

@@ -1,0 +1,76 @@
+"""HealthMonitor: device probe, stall detection, recovery (the TPU-native
+replacement for the reference's 10s backend poll, dispatcher.rs:261-387)."""
+
+import time
+
+from ollamamq_tpu.engine import health as health_mod
+from ollamamq_tpu.engine.health import HealthMonitor
+
+
+class _FakeCore:
+    def __init__(self):
+        self.queued = 1
+
+    def total_queued(self):
+        return self.queued
+
+
+class _FakeRt:
+    def __init__(self):
+        self.tokens_generated = 0
+
+    def has_work(self):
+        return True
+
+
+class _FakeEngine:
+    def __init__(self):
+        self.core = _FakeCore()
+        self.runtimes = {"m": _FakeRt()}
+
+
+def test_stall_detected_then_recovers(monkeypatch):
+    monkeypatch.setattr(health_mod, "STALL_DEADLINE_S", 0.2)
+    eng = _FakeEngine()
+    hm = HealthMonitor(eng, period_s=0.05)
+    hm.start()
+    try:
+        deadline = time.monotonic() + 10
+        while not hm.engine_stalled and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert hm.engine_stalled, "stall (work pending, no tokens) not flagged"
+        # Progress resumes: tokens advance -> stall clears.
+        eng.runtimes["m"].tokens_generated = 5
+        deadline = time.monotonic() + 10
+        while hm.engine_stalled and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not hm.engine_stalled
+        # Idle (no work) is never a stall.
+        eng.core.queued = 0
+
+        class _IdleRt(_FakeRt):
+            def has_work(self):
+                return False
+
+        eng.runtimes["m"] = _IdleRt()
+        time.sleep(0.5)
+        assert not hm.engine_stalled
+    finally:
+        hm.stop()
+
+
+def test_device_probe_online_and_status():
+    eng = _FakeEngine()
+    hm = HealthMonitor(eng, period_s=0.05)
+    hm.start()
+    try:
+        deadline = time.monotonic() + 20
+        while hm.last_device_check == 0.0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert hm.last_device_check > 0.0
+        assert hm.device_online  # CPU backend answers the probe
+        st = hm.status()
+        assert set(st) == {"device_online", "engine_stalled",
+                           "last_device_check"}
+    finally:
+        hm.stop()
