@@ -89,14 +89,13 @@ def main(argv=None) -> int:
     log = logging.getLogger("ollamamq")
 
     if args.cpu:
+        from ollamamq_tpu.parallel.distributed import multiprocess_configured
         from ollamamq_tpu.platform_force import force_cpu
 
         # Multi-process only: defer the backend-touch verification, since
         # jax.distributed.initialize below must run before the first
         # backend touch. Single-process keeps the loud platform check.
-        multiproc = bool(os.environ.get("JAX_COORDINATOR_ADDRESS")
-                         or os.environ.get("JAX_NUM_PROCESSES"))
-        force_cpu(args.cpu, check=not multiproc)
+        force_cpu(args.cpu, check=not multiprocess_configured())
 
     from ollamamq_tpu.config import EngineConfig
     from ollamamq_tpu.core import Fairness

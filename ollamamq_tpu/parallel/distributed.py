@@ -22,6 +22,18 @@ from ollamamq_tpu.parallel.mesh import make_mesh
 log = logging.getLogger("ollamamq.distributed")
 
 
+def multiprocess_configured() -> bool:
+    """True when the env opts into a multi-process runtime — the SAME
+    condition initialize() uses to decide whether to bring one up (callers
+    that must defer backend-touching work until after initialize() share
+    this instead of re-deriving it)."""
+    env_np = os.environ.get("JAX_NUM_PROCESSES")
+    num_processes = int(env_np) if env_np else None
+    return bool(os.environ.get("JAX_COORDINATOR_ADDRESS")) or (
+        num_processes not in (None, 1)
+    )
+
+
 def initialize(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,

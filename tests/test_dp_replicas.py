@@ -12,6 +12,7 @@ from ollamamq_tpu.config import EngineConfig
 from ollamamq_tpu.engine.engine import ReplicaSet, TPUEngine
 from ollamamq_tpu.engine.request import FinishReason, Request
 from ollamamq_tpu.ops.sampling import SamplingParams
+from testutil import collect
 
 
 def dp_cfg(**kw):
@@ -30,19 +31,6 @@ def dp_engine():
     eng.start()
     yield eng
     eng.stop()
-
-
-def collect(req, timeout=120):
-    deadline = time.monotonic() + timeout
-    items = []
-    while time.monotonic() < deadline:
-        item = req.stream.get(timeout=0.2)
-        if item is None:
-            continue
-        items.append(item)
-        if item.kind in ("done", "error"):
-            return items
-    raise TimeoutError(f"request {req.req_id} did not finish")
 
 
 def test_replicas_shard_over_disjoint_device_slices(dp_engine):

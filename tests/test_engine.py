@@ -9,6 +9,7 @@ from ollamamq_tpu.engine.engine import TPUEngine
 from ollamamq_tpu.engine.fake import FakeEngine
 from ollamamq_tpu.engine.request import FinishReason, Request
 from ollamamq_tpu.ops.sampling import SamplingParams
+from testutil import collect
 
 
 def small_cfg(**kw):
@@ -37,18 +38,6 @@ def run_request(eng, user="u", model="test-tiny", prompt="hello world",
                   SamplingParams(max_tokens=max_tokens, stop=tuple(stop)))
     eng.submit(req)
     return collect(req, timeout), req
-
-
-def collect(req, timeout=60):
-    items, deadline = [], time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        item = req.stream.get(timeout=0.2)
-        if item is None:
-            continue
-        items.append(item)
-        if item.kind in ("done", "error"):
-            return items
-    raise TimeoutError(f"request {req.req_id} did not finish; got {items}")
 
 
 def test_generate_end_to_end(engine):

@@ -11,6 +11,7 @@ from ollamamq_tpu.config import EngineConfig
 from ollamamq_tpu.engine.engine import TPUEngine
 from ollamamq_tpu.engine.request import Request
 from ollamamq_tpu.ops.sampling import SamplingParams
+from testutil import collect
 
 
 def cfg(sp):
@@ -19,19 +20,6 @@ def cfg(sp):
         max_pages_per_seq=32, prefill_buckets=(16, 32, 64),
         max_new_tokens=8, decode_steps_per_iter=2, sp=sp,
     )
-
-
-def collect(req, timeout=120):
-    deadline = time.monotonic() + timeout
-    items = []
-    while time.monotonic() < deadline:
-        item = req.stream.get(timeout=0.2)
-        if item is None:
-            continue
-        items.append(item)
-        if item.kind in ("done", "error"):
-            return items
-    raise TimeoutError(f"request {req.req_id} did not finish")
 
 
 def run_long_prompt(eng, user):

@@ -1,0 +1,17 @@
+"""Shared test helpers (pytest puts this directory on sys.path)."""
+
+import time
+
+
+def collect(req, timeout=120):
+    """Drain a request's stream until its terminal item (done/error)."""
+    deadline = time.monotonic() + timeout
+    items = []
+    while time.monotonic() < deadline:
+        item = req.stream.get(timeout=0.2)
+        if item is None:
+            continue
+        items.append(item)
+        if item.kind in ("done", "error"):
+            return items
+    raise TimeoutError(f"request {req.req_id} did not finish; got {items}")
