@@ -379,6 +379,20 @@ int64_t mq_total_queued(mq_state *s) {
   return n;
 }
 
+int64_t mq_queued_matching(mq_state *s, const char *model) {
+  /* Queued tasks THIS model could serve (no model requested, or a smart
+   * match) — lets the engine's decode-chunk policy ignore backlog that can
+   * never admit into a given runtime (e.g. requests parked for an evicted
+   * model) instead of dropping to per-token dispatch for the outage. */
+  std::lock_guard<std::mutex> g(s->mu);
+  std::vector<std::string> have{model ? model : ""};
+  int64_t n = 0;
+  for (auto &kv : s->queues)
+    for (auto &t : kv.second)
+      if (t.model.empty() || smart_model_match(t.model, have)) n += 1;
+  return n;
+}
+
 int64_t mq_snapshot_json(mq_state *s, char *out, int64_t cap) {
   std::lock_guard<std::mutex> g(s->mu);
   std::string j = "{";

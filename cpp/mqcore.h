@@ -101,6 +101,8 @@ void mq_set_fairness_mode(mq_state *, int mode);
 /* Queue depth for one user / total queued. */
 int64_t mq_queue_len(mq_state *, const char *user);
 int64_t mq_total_queued(mq_state *);
+/* Queued tasks a given model could serve (empty-model tasks count). */
+int64_t mq_queued_matching(mq_state *, const char *model);
 
 /* Full state snapshot as JSON (users, counters, queues, vip/boost, blocked).
  * Returns bytes written (excluding NUL), or required size if cap too small. */

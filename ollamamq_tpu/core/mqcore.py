@@ -90,6 +90,8 @@ def _load() -> ctypes.CDLL:
     lib.mq_queue_len.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.mq_total_queued.restype = ctypes.c_int64
     lib.mq_total_queued.argtypes = [ctypes.c_void_p]
+    lib.mq_queued_matching.restype = ctypes.c_int64
+    lib.mq_queued_matching.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.mq_snapshot_json.restype = ctypes.c_int64
     lib.mq_snapshot_json.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
     return lib
@@ -224,6 +226,10 @@ class MQCore:
 
     def total_queued(self) -> int:
         return self._lib.mq_total_queued(self._h)
+
+    def queued_matching(self, model: str) -> int:
+        """Queued tasks `model` could serve (empty-model tasks count)."""
+        return int(self._lib.mq_queued_matching(self._h, model.encode()))
 
     def snapshot(self) -> dict:
         need = self._lib.mq_snapshot_json(self._h, None, 0)

@@ -1431,10 +1431,24 @@ class TPUEngine:
                         if rt.step_chunk(self.core):
                             did_work = True
                         if any(r is not None for r in rt.slot_req):
-                            more_waiting = bool(rt.pending_prefill) or bool(
-                                self.core.total_queued()
+                            # Short decode chunks (k=1) keep TTFT low ONLY
+                            # when an admission could actually land between
+                            # steps: pending work AND a free seat, or a
+                            # chunked prefill to interleave. A saturated
+                            # batch with a deep backlog must run the full
+                            # fused chunk — per-step dispatch latency (the
+                            # TPU tunnel round trip) would otherwise gate
+                            # every token under exactly the 64-user load
+                            # the engine is built for.
+                            # Scoped to work THIS runtime could serve:
+                            # backlog parked for another (or evicted) model
+                            # must not hold a healthy runtime at k=1.
+                            waiting = bool(rt.pending_prefill) or bool(
+                                self.core.queued_matching(rt.name)
                             )
-                            k = 1 if more_waiting else self.ecfg.decode_steps_per_iter
+                            can_admit = waiting and rt.has_capacity()
+                            k = (1 if (can_admit or rt.chunking)
+                                 else self.ecfg.decode_steps_per_iter)
                             rt.step_decode(self.core, k_steps=k)
                             did_work = True
                     else:

@@ -169,6 +169,22 @@ def test_block_version_and_combined_check(core):
     assert not core.is_user_or_ip_blocked("ipuser")
 
 
+def test_queued_matching_scopes_by_model(core):
+    """mq_queued_matching counts only tasks a model could serve (smart
+    match or no model requested) — the decode-chunk policy's gate."""
+    core.enqueue("qm1", model="llama3:8b")
+    core.enqueue("qm2", model="LLAMA3")  # smart-matches llama3:8b
+    core.enqueue("qm3", model="qwen2.5:7b")
+    core.enqueue("qm4", model=None)  # servable by anyone
+    assert core.queued_matching("llama3:8b") == 3
+    assert core.queued_matching("qwen2.5:7b") == 2
+    assert core.queued_matching("nomic-embed-text") == 1
+    # Drain for other tests.
+    while core.next(eligible_models=["llama3:8b", "qwen2.5:7b",
+                                     "nomic-embed-text"]):
+        pass
+
+
 def test_blocklist_persistence(tmp_path):
     """blocked_items.json round-trip, reference-compatible schema
     (dispatcher.rs:19-25,165-182)."""

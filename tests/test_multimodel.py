@@ -30,7 +30,10 @@ def setup():
 
 
 def run(eng, user, model, max_tokens=4):
-    tok = next(iter(eng.runtimes.values())).tokenizer
+    # Target model's tokenizer when loaded; any runtime's only for the
+    # deliberately-evicted case (both test models use ByteTokenizer).
+    rt = eng.runtimes.get(model) or next(iter(eng.runtimes.values()))
+    tok = rt.tokenizer
     rid = eng.core.enqueue(user, "", model)
     req = Request(rid, user, model, tok.encode(f"for {model}"),
                   SamplingParams(max_tokens=max_tokens))
