@@ -8,6 +8,19 @@ buffered DMA streams K/V pages HBM→VMEM while the previous page's partial
 attention accumulates with an online (flash-style) softmax, so HBM reads
 scale with true context length (ragged), not the padded maximum.
 
+Mosaic layout constraints (learned against the real v5e compiler):
+  - DMA slices must be tile-aligned: a [.., Hk, hd=64] block sits padded
+    inside 128-lane tiles and cannot be sliced, so K/V move as flattened
+    [page_size, Hk*hd] rows (Hk*hd is a multiple of 128).
+  - In-kernel reshapes/transposes that split or merge the lane dim are
+    "unsupported shape cast" relayouts. GQA head bookkeeping therefore
+    happens OUTSIDE the kernel: q arrives packed as [B, group, Hk*hd]
+    (query-group-major, kv-segment lanes) and per-head score/weight
+    segmentation uses constant 0/1 segment matrices on the MXU:
+        scores_g = (k_row * q_g) @ SEG          [ps, Hk]
+        expand_g = p_g @ SEG.T                  [ps, Hk*hd]
+    so every vector op keeps its layout end to end.
+
 Layout contract (matches engine/kv_cache.py):
     k_cache, v_cache: [S, Hk, hd] flat slot pool; a page is `page_size`
     contiguous slots starting at page_id * page_size.
@@ -36,34 +49,44 @@ def _decode_kernel(
     page_table_ref,  # [B, max_pages] SMEM
     seq_lens_ref,  # [B] SMEM
     # inputs
-    q_ref,  # [1, H, hd] VMEM (this program's query)
-    k_hbm,  # [S, Hk, hd] HBM
-    v_hbm,  # [S, Hk, hd] HBM
+    q_ref,  # [1, group, Hk*hd] VMEM (this program's query, packed)
+    k_hbm,  # [S, Hk*hd] HBM
+    v_hbm,  # [S, Hk*hd] HBM
     # output
-    o_ref,  # [1, H, hd] VMEM
+    o_ref,  # [1, group, Hk*hd] VMEM (packed like q)
     # scratch
-    k_buf,  # [2, page_size, Hk, hd] VMEM
-    v_buf,  # [2, page_size, Hk, hd] VMEM
-    acc,  # [H, hd] f32 VMEM
-    m_i,  # [H, 1] f32 VMEM running max
-    l_i,  # [H, 1] f32 VMEM running denom
-    sems,  # [2, 2] DMA semaphores (buffer, k/v)
+    k_buf,  # [R, page_size, Hk*hd] VMEM ring
+    v_buf,  # [R, page_size, Hk*hd] VMEM ring
+    acc,  # [group, Hk*hd] f32 VMEM
+    m_i,  # [group, Hk] f32 VMEM running max
+    l_i,  # [group, Hk] f32 VMEM running denom
+    sems,  # [R, 2] DMA semaphores (buffer, k/v)
     *,
     page_size: int,
     max_pages: int,
     num_heads: int,
     num_kv_heads: int,
     head_dim: int,
+    ring: int,
 ):
     b = pl.program_id(0)
+    nb = pl.num_programs(0)
     seq_len = seq_lens_ref[b]
+
     # Clamp to the table width: a seq_len beyond capacity must not index
     # page_table out of bounds (the jnp reference implicitly truncates the
     # context the same way).
-    num_pages = jnp.minimum(pl.cdiv(seq_len, page_size), max_pages)
+    def pages_of(row):
+        return jnp.minimum(
+            pl.cdiv(seq_lens_ref[row], page_size), max_pages
+        )
 
-    def page_dma(slot, page_idx):
-        page_id = page_table_ref[b, page_idx]
+    num_pages = pages_of(b)
+    group = num_heads // num_kv_heads
+    lanes = num_kv_heads * head_dim
+
+    def page_dma(slot, row, page_idx):
+        page_id = page_table_ref[row, page_idx]
         start = page_id * page_size
         k_dma = pltpu.make_async_copy(
             k_hbm.at[pl.ds(start, page_size)], k_buf.at[slot], sems.at[slot, 0]
@@ -73,69 +96,117 @@ def _decode_kernel(
         )
         return k_dma, v_dma
 
-    # Warm up: first page in flight.
-    k0, v0 = page_dma(0, 0)
-    k0.start()
-    v0.start()
+    def start_page(slot, row, page_idx):
+        k_dma, v_dma = page_dma(slot, row, page_idx)
+        k_dma.start()
+        v_dma.start()
+
+    # Fill the ring — but ONLY for the first grid program: every later
+    # program's first `ring` pages were started by its predecessor's
+    # epilogue (cross-program prefetch), so the DMA pipeline never drains
+    # at a program boundary. Starts and waits share the same `i <
+    # num_pages` condition, so semaphore counts always balance.
+    for i in range(ring):
+        @pl.when((b == 0) & (i < num_pages))
+        def _(i=i):
+            start_page(i % ring, b, i)
 
     acc[...] = jnp.zeros_like(acc)
     m_i[...] = jnp.full_like(m_i, NEG_INF)
     l_i[...] = jnp.zeros_like(l_i)
 
-    q = q_ref[0].astype(jnp.float32)  # [H, hd]
     scale = 1.0 / (head_dim ** 0.5)
-    group = num_heads // num_kv_heads
+    # Segment matrices: SEG[d, h] = 1 iff lane d belongs to kv head h.
+    # Constant f32 [lanes, Hk] / [Hk, lanes]; they ride VMEM and let the
+    # MXU do per-head lane reductions/expansions without relayouts.
+    seg = (
+        jax.lax.broadcasted_iota(jnp.int32, (lanes, num_kv_heads), 0)
+        // head_dim
+        == jax.lax.broadcasted_iota(jnp.int32, (lanes, num_kv_heads), 1)
+    ).astype(jnp.float32)
+    seg_t = (
+        jax.lax.broadcasted_iota(jnp.int32, (num_kv_heads, lanes), 1)
+        // head_dim
+        == jax.lax.broadcasted_iota(jnp.int32, (num_kv_heads, lanes), 0)
+    ).astype(jnp.float32)
 
     def body(p, _):
-        slot = p % 2
-        nxt = (p + 1) % 2
+        slot = p % ring
 
-        @pl.when(p + 1 < num_pages)
-        def _():
-            kn, vn = page_dma(nxt, p + 1)
-            kn.start()
-            vn.start()
-
-        kp, vp = page_dma(slot, p)
+        kp, vp = page_dma(slot, b, p)
         kp.wait()
         vp.wait()
 
-        k = k_buf[slot].astype(jnp.float32)  # [ps, Hk, hd]
+        k = k_buf[slot].astype(jnp.float32)  # [ps, lanes]
         v = v_buf[slot].astype(jnp.float32)
-        # GQA: broadcast kv heads over query-head groups.
-        # scores[h, t] = q[h] . k[t, h // group]
-        qr = q.reshape(num_kv_heads, group, head_dim)
-        s = jax.lax.dot_general(
-            qr, k,
-            dimension_numbers=(((2,), (2,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32,
-        )  # [Hk, group, ps]
-        s = s.reshape(num_heads, page_size) * scale
 
-        # Mask positions beyond the sequence (final partial page).
+        # Ring slot consumed (values loaded above): refill it with the
+        # page `ring` ahead, keeping ring-1 copies in flight.
+        @pl.when(p + ring < num_pages)
+        def _():
+            start_page(slot, b, p + ring)
+        # Valid-position mask for this page (final page may be partial).
         pos = p * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (num_heads, page_size), 1
+            jnp.int32, (page_size, num_kv_heads), 0
         )
-        s = jnp.where(pos < seq_len, s, NEG_INF)
+        valid = pos < seq_len  # [ps, Hk]
 
-        # Online softmax update.
-        m_prev = m_i[...]  # [H, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p_ij = jnp.exp(s - m_new)  # [H, ps]
-        l_i[...] = l_i[...] * alpha + jnp.sum(p_ij, axis=1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p_ij.reshape(num_kv_heads, group, page_size), v,
-            dimension_numbers=(((2,), (0,)), ((0,), (1,))),
-            preferred_element_type=jnp.float32,
-        )  # [Hk, group, hd]
-        acc[...] = acc[...] * alpha + pv.reshape(num_heads, head_dim)
-        m_i[...] = m_new
+        for g in range(group):  # static unroll; group is small (1-8)
+            qg = q_ref[0, g : g + 1, :].astype(jnp.float32)  # [1, lanes]
+            # scores[t, h] = sum_d q[h-seg d] * k[t, d]  via masked-lane
+            # elementwise product + segment-sum on the MXU.
+            s = jax.lax.dot_general(
+                k * qg, seg,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [ps, Hk]
+            s = jnp.where(valid, s, NEG_INF)
+
+            # Online softmax update for this query group.
+            m_prev = m_i[g : g + 1, :]  # [1, Hk]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=0, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)  # [1, Hk]
+            p_ij = jnp.exp(s - m_new)  # [ps, Hk]
+            l_i[g : g + 1, :] = l_i[g : g + 1, :] * alpha + jnp.sum(
+                p_ij, axis=0, keepdims=True
+            )
+            # Per-head weights expanded back to lane segments, then a
+            # sublane reduction contracts over page positions.
+            e = jax.lax.dot_general(
+                p_ij, seg_t,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [ps, lanes]
+            contrib = jnp.sum(e * v, axis=0, keepdims=True)  # [1, lanes]
+            alpha_l = jax.lax.dot_general(
+                alpha, seg_t,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [1, lanes]
+            acc[g : g + 1, :] = acc[g : g + 1, :] * alpha_l + contrib
+            m_i[g : g + 1, :] = m_new
         return ()
 
     jax.lax.fori_loop(0, num_pages, body, ())
 
-    denom = jnp.maximum(l_i[...], 1e-20)
+    # Cross-program prefetch: start the NEXT batch element's first `ring`
+    # pages. Every one of this program's copies has been consumed by the
+    # loop above (refills are guarded to < num_pages), so all ring slots
+    # are free; the next program starts no DMAs of its own and its body
+    # waits land on copies already in flight. The row index is clamped
+    # BEFORE the predicate so the last program never reads seq_lens_ref
+    # out of bounds (the b+1 < nb guard then discards the dummy value).
+    succ = jnp.minimum(b + 1, nb - 1)
+    for i in range(ring):
+        @pl.when((b + 1 < nb) & (i < pages_of(succ)))
+        def _(i=i):
+            start_page(i % ring, succ, i)
+
+    denom = jax.lax.dot_general(
+        jnp.maximum(l_i[...], 1e-20), seg_t,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [group, lanes]
     o_ref[0] = (acc[...] / denom).astype(o_ref.dtype)
 
 
@@ -152,7 +223,12 @@ def paged_decode_attention_pallas(
     B, H, hd = q.shape
     _, Hk, _ = k_cache.shape
     max_pages = page_table.shape[1]
+    group = H // Hk
+    lanes = Hk * hd
 
+    # Pages in flight per sequence: measured on v5e, 4-16 are within noise
+    # of each other (the DMA path is issue-overhead-bound); 8 is the middle.
+    ring = 8
     kernel = functools.partial(
         _decode_kernel,
         page_size=page_size,
@@ -160,33 +236,45 @@ def paged_decode_attention_pallas(
         num_heads=H,
         num_kv_heads=Hk,
         head_dim=hd,
+        ring=ring,
     )
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B,),
         in_specs=[
-            pl.BlockSpec((1, H, hd), lambda b, *_: (b, 0, 0),
+            pl.BlockSpec((1, group, lanes), lambda b, *_: (b, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),  # k stays in HBM
             pl.BlockSpec(memory_space=pl.ANY),  # v stays in HBM
         ],
-        out_specs=pl.BlockSpec((1, H, hd), lambda b, *_: (b, 0, 0),
+        out_specs=pl.BlockSpec((1, group, lanes), lambda b, *_: (b, 0, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((2, page_size, Hk, hd), k_cache.dtype),
-            pltpu.VMEM((2, page_size, Hk, hd), v_cache.dtype),
-            pltpu.VMEM((H, hd), jnp.float32),
-            pltpu.VMEM((H, 1), jnp.float32),
-            pltpu.VMEM((H, 1), jnp.float32),
-            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.VMEM((ring, page_size, lanes), k_cache.dtype),
+            pltpu.VMEM((ring, page_size, lanes), v_cache.dtype),
+            pltpu.VMEM((group, lanes), jnp.float32),
+            pltpu.VMEM((group, Hk), jnp.float32),
+            pltpu.VMEM((group, Hk), jnp.float32),
+            pltpu.SemaphoreType.DMA((ring, 2)),
         ],
     )
 
-    return pl.pallas_call(
+    # Pack q head-group-major so each kernel row g holds every kv head's
+    # group-g query in its lane segment: q_packed[b, g, h*hd + d] =
+    # q[b, h*group + g, d]. (Plain XLA transposes are free of Mosaic's
+    # relayout limits; doing this outside the kernel keeps the kernel
+    # relayout-free.)
+    q_packed = (
+        q.reshape(B, Hk, group, hd).transpose(0, 2, 1, 3).reshape(B, group, lanes)
+    )
+    out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, group, lanes), q.dtype),
         interpret=interpret,
     )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
-      q, k_cache, v_cache)
+      q_packed, k_cache.reshape(-1, lanes), v_cache.reshape(-1, lanes))
+    return (
+        out.reshape(B, group, Hk, hd).transpose(0, 2, 1, 3).reshape(B, H, hd)
+    )

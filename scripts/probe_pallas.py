@@ -1,0 +1,105 @@
+"""Iterate the Pallas decode kernel against the real TPU's Mosaic
+compiler: AOT-compile (no execution, no donation) at the bench shapes,
+then optionally execute and cross-check numerics vs the jnp reference
+path. Usage: python scripts/probe_pallas.py [--run]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--run", action="store_true",
+                   help="execute + compare against the jnp reference")
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--heads", type=int, default=32)
+    p.add_argument("--kv-heads", type=int, default=8)
+    p.add_argument("--head-dim", type=int, default=64)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--max-pages", type=int, default=26)
+    p.add_argument("--bench", action="store_true",
+                   help="time pallas vs jnp attention at these shapes")
+    args = p.parse_args()
+
+    from ollamamq_tpu.ops.attention import paged_decode_attention
+    from ollamamq_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention_pallas,
+    )
+
+    B, H, Hk, hd = args.batch, args.heads, args.kv_heads, args.head_dim
+    ps, MP = args.page_size, args.max_pages
+    S = B * MP + 2  # slot pool incl. trash page
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, H, hd)), jnp.bfloat16)
+    kc = jnp.asarray(rng.standard_normal((S * ps, Hk, hd)), jnp.bfloat16)
+    vc = jnp.asarray(rng.standard_normal((S * ps, Hk, hd)), jnp.bfloat16)
+    # Ragged lengths; page tables point at disjoint pages (page 0 = trash).
+    seq_lens = jnp.asarray(rng.integers(1, MP * ps, size=(B,)), jnp.int32)
+    pt = np.zeros((B, MP), np.int32)
+    next_page = 1
+    for b in range(B):
+        n = -(-int(seq_lens[b]) // ps)
+        for i in range(n):
+            pt[b, i] = next_page
+            next_page += 1
+    pt = jnp.asarray(pt)
+
+    t0 = time.monotonic()
+    lowered = jax.jit(
+        lambda q, kc, vc, pt, sl: paged_decode_attention_pallas(
+            q, kc, vc, pt, sl, page_size=ps
+        )
+    ).lower(q, kc, vc, pt, seq_lens)
+    compiled = lowered.compile()
+    print(f"COMPILE OK in {time.monotonic() - t0:.1f}s", flush=True)
+
+    if args.run or args.bench:
+        t0 = time.monotonic()
+        out = np.asarray(compiled(q, kc, vc, pt, seq_lens))
+        print(f"RUN OK in {time.monotonic() - t0:.2f}s", flush=True)
+        ref = np.asarray(
+            paged_decode_attention(q, kc, vc, pt, seq_lens, page_size=ps)
+        )
+        err = np.abs(out.astype(np.float32) - ref.astype(np.float32)).max()
+        print(f"MAX ABS DIFF vs jnp: {err:.5f}", flush=True)
+        if err > 0.1:
+            print("NUMERIC MISMATCH", flush=True)
+            return 1
+
+    if args.bench:
+        jref = jax.jit(
+            lambda q, kc, vc, pt, sl: paged_decode_attention(
+                q, kc, vc, pt, sl, page_size=ps
+            )
+        )
+        np.asarray(jref(q, kc, vc, pt, seq_lens))
+        for name, fn in (("pallas", compiled), ("jnp", jref)):
+            # block_until_ready is NOT a reliable fence through the axon
+            # tunnel; a device->host fetch of the result is. Chain the
+            # timed calls on q so they cannot overlap-reorder, and fetch.
+            qi = q
+            t0 = time.monotonic()
+            for _ in range(50):
+                r = fn(qi, kc, vc, pt, seq_lens)
+                qi = r
+            np.asarray(r)
+            dt = (time.monotonic() - t0) / 50
+            print(f"{name}: {dt * 1e6:.0f} us/call", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
