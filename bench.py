@@ -45,6 +45,11 @@ def main() -> int:
     p.add_argument("--chunk", type=int, default=16, help="decode steps per dispatch")
     p.add_argument("--warmup-steps", type=int, default=32)
     p.add_argument("--ttft-samples", type=int, default=8)
+    p.add_argument("--page-size", type=int, default=16,
+                   help="KV page size (tokens per page)")
+    p.add_argument("--sampled", action="store_true",
+                   help="use Ollama-default sampling (temp 0.8, repeat 1.1) "
+                        "instead of greedy — exercises the full sampler")
     p.add_argument("--long-prompt", type=int, default=0,
                    help="if >0, also time chunked prefill of a prompt this "
                         "long (should exceed the largest bucket)")
@@ -120,7 +125,7 @@ def main() -> int:
     # Pages: prompt + generated headroom for every slot.
     tokens_per_seq = max(args.prompt_len + args.steps + args.chunk,
                          args.long_prompt + args.chunk)
-    page_size = 16
+    page_size = args.page_size
     pages_per_seq = -(-tokens_per_seq // page_size) + 1
     ecfg = EngineConfig(
         model=args.model,
@@ -149,8 +154,10 @@ def main() -> int:
     def make_req(i):
         prompt = rng.integers(3, min(model_cfg.vocab_size, 30000),
                               size=args.prompt_len).tolist()
-        req = Request(i + 1, f"user{i}", args.model, prompt,
-                      SamplingParams(max_tokens=10**9))
+        sp = (SamplingParams(max_tokens=10**9, temperature=0.8,
+                             repeat_penalty=1.1, seed=i + 1)
+              if args.sampled else SamplingParams(max_tokens=10**9))
+        req = Request(i + 1, f"user{i}", args.model, prompt, sp)
         req._inc_decode = rt.tokenizer.make_incremental_decoder()
         return req
 
@@ -286,6 +293,19 @@ def main() -> int:
         finally:
             embed_done.set()
 
+    # Hardware-relative efficiency: decode is HBM-bandwidth-bound, so
+    # report achieved weight+KV streaming rate and MFU against v5e peak
+    # (819 GB/s, 394 bf16 TFLOP/s) — "fast" judged against the chip, not
+    # only the 2000 tok/s target. KV read per step ~ active x mean
+    # context x Hk x hd x 2 (K+V) x bytes x layers.
+    step_s = elapsed / max(1, done_steps)
+    mean_ctx = args.prompt_len + (args.warmup_steps + done_steps / 2)
+    kv_read = (active * mean_ctx * rt.cfg.num_kv_heads * rt.cfg.head_dim
+               * 2 * 2 * rt.cfg.num_layers)
+    hbm_gbps = (rt.param_bytes + kv_read) / step_s / 1e9
+    flops_per_step = 2 * (rt.param_bytes / 2) * active  # 2*params*tokens
+    mfu_pct = flops_per_step / step_s / 394e12 * 100
+
     result = {
         "metric": "decode_tok_per_s_per_chip",
         "value": round(tok_per_s, 1),
@@ -293,11 +313,15 @@ def main() -> int:
         "vs_baseline": round(tok_per_s / 2000.0, 3),
         "model": args.model,
         "device": str(dev),
+        "hbm_gbps_est": round(hbm_gbps, 1),
+        "mfu_pct_est": round(mfu_pct, 2),
+        "page_size": page_size,
+        "sampled": args.sampled,
         "slots": active,
         "prompt_len": args.prompt_len,
         "decode_steps": done_steps,
         "chunk": args.chunk,
-        "step_ms": round(elapsed / max(1, done_steps) * 1e3, 3),
+        "step_ms": round(step_s * 1e3, 3),
         "ttft_p50_ms": round(ttft_p50_ms, 1),
         "ttft_compile_ms": round(ttft_compile_ms, 1),
         "init_s": round(init_s, 1),

@@ -45,8 +45,8 @@ from ollamamq_tpu.engine import kv_cache as kvc
 from ollamamq_tpu.engine.request import FinishReason, Request, StreamItem
 from ollamamq_tpu.engine.tokenizer import load_tokenizer
 from ollamamq_tpu.models import llama, weights
-from ollamamq_tpu.ops.sampling import (apply_penalties, per_row_keys,
-                                       sample_tokens_rowwise)
+from ollamamq_tpu.ops.sampling import (maybe_apply_penalties, per_row_keys,
+                                       sample_tokens_rowwise, sampling_flags)
 from ollamamq_tpu.parallel.mesh import make_mesh, validate_tp_for_model
 from ollamamq_tpu.parallel.sharding import kv_cache_spec, shard_params
 
@@ -162,8 +162,10 @@ class ModelRuntime:
         # Requests inside a prefill forward right now (cancel() must still
         # find them; installation re-checks the cancelled flag).
         self.inflight_prefill: List[Request] = []
-        self._prefill_jits: Dict[tuple, callable] = {}  # (bucket, B) | ("chunk", C)
-        self._decode_jits: Dict[int, callable] = {}
+        # Keys carry the trace-time sampling flags: (bucket, B, flags) |
+        # ("chunk", C, flags) | ("sp", T, flags); decode: (k_steps, flags).
+        self._prefill_jits: Dict[tuple, callable] = {}
+        self._decode_jits: Dict[tuple, callable] = {}
         self._rng_counter = engine_cfg.seed
         # Sequence-parallel prefill available when the mesh has a seq axis.
         self._sp = mesh is not None and mesh.shape.get("seq", 1) > 1
@@ -245,7 +247,9 @@ class ModelRuntime:
     # the three state arrays back.
     def _dispatch_prefill(self, bucket, B, tokens, lens, slot_ids, pt_rows,
                           temp, tk, tp, pen, pres, freq, seeds, key):
-        fn = self._get_prefill_jit(bucket, B)
+        fn = self._get_prefill_jit(
+            bucket, B, sampling_flags(temp, tk, tp, pen, pres, freq)
+        )
         return fn(self.params, jnp.asarray(tokens), jnp.asarray(lens),
                   self.kc, self.vc, self.recent, jnp.asarray(slot_ids),
                   jnp.asarray(pt_rows), jnp.asarray(temp), jnp.asarray(tk),
@@ -254,7 +258,9 @@ class ModelRuntime:
 
     def _dispatch_chunk(self, chunk, tokens, start, cl, slot_id, is_final,
                         pt_row, temp, tk, tp, pen, pres, freq, seeds, key):
-        fn = self._get_chunk_jit(chunk)
+        fn = self._get_chunk_jit(
+            chunk, sampling_flags(temp, tk, tp, pen, pres, freq)
+        )
         return fn(self.params, jnp.asarray(tokens), jnp.asarray(start),
                   jnp.asarray(cl), self.kc, self.vc, self.recent,
                   jnp.asarray(slot_id), jnp.asarray(is_final),
@@ -264,17 +270,21 @@ class ModelRuntime:
 
     def _dispatch_decode(self, k_steps, tokens, positions, active, pt, temp,
                          tk, tp, pen, pres, freq, seeds, key):
-        fn = self._get_decode_jit(k_steps)
+        fn = self._get_decode_jit(
+            k_steps, sampling_flags(temp, tk, tp, pen, pres, freq)
+        )
         return fn(self.params, jnp.asarray(tokens), jnp.asarray(positions),
                   self.kc, self.vc, self.recent, jnp.asarray(active),
                   jnp.asarray(pt), jnp.asarray(temp), jnp.asarray(tk),
                   jnp.asarray(tp), jnp.asarray(pen), jnp.asarray(pres),
                   jnp.asarray(freq), jnp.asarray(seeds), key)
 
-    def _get_prefill_jit(self, bucket: int, batch: int = 1):
-        key_ = (bucket, batch)
+    def _get_prefill_jit(self, bucket: int, batch: int = 1,
+                         flags=(True, True, True)):
+        key_ = (bucket, batch, flags)
         if key_ not in self._prefill_jits:
             cfg, ps = self.cfg, self.ecfg.page_size
+            need_pen, need_mask, need_sample = flags
 
             def fn(params, tokens, seq_lens, kc, vc, recent, slot_ids, pt,
                    temp, tk, tp, pen, pres, freq, seeds, key):
@@ -289,9 +299,11 @@ class ModelRuntime:
                     tokens, jnp.clip(idx, 0, T - 1), axis=1
                 )
                 rows = jnp.where(idx >= 0, gathered, -1)
-                pen_logits = apply_penalties(logits, rows, pen, pres, freq)
+                pen_logits = maybe_apply_penalties(logits, rows, pen, pres,
+                                                   freq, need_pen)
                 row_keys = per_row_keys(key, seeds, seq_lens)
-                tok = sample_tokens_rowwise(pen_logits, row_keys, temp, tk, tp)
+                tok = sample_tokens_rowwise(pen_logits, row_keys, temp, tk,
+                                            tp, need_mask, need_sample)
                 rows = jnp.concatenate([rows[:, 1:], tok[:, None]], axis=1)
                 recent = recent.at[slot_ids].set(rows)
                 return tok, kc, vc, recent
@@ -299,12 +311,13 @@ class ModelRuntime:
             self._prefill_jits[key_] = jax.jit(fn, donate_argnums=(3, 4, 5))
         return self._prefill_jits[key_]
 
-    def _get_chunk_jit(self, chunk: int):
+    def _get_chunk_jit(self, chunk: int, flags=(True, True, True)):
         """Chunked prefill step for prompts longer than the largest bucket:
         each call writes one chunk's K/V and attends over the prefix. The
         returned sampled token is only meaningful for the final chunk."""
-        if ("chunk", chunk) not in self._prefill_jits:
+        if ("chunk", chunk, flags) not in self._prefill_jits:
             cfg, ps = self.cfg, self.ecfg.page_size
+            need_pen, need_mask, need_sample = flags
 
             def fn(params, tokens, start, chunk_lens, kc, vc, recent, slot_id,
                    is_final, pt, temp, tk, tp, pen, pres, freq, seeds, key):
@@ -322,36 +335,43 @@ class ModelRuntime:
                 )
                 combined = jnp.concatenate([row, chunk_toks])  # [W+C]
                 row = jax.lax.dynamic_slice(combined, (chunk_lens[0],), (W,))
-                pen_logits = apply_penalties(logits, row[None], pen, pres, freq)
+                pen_logits = maybe_apply_penalties(logits, row[None], pen,
+                                                   pres, freq, need_pen)
                 row_keys = per_row_keys(key, seeds, start + chunk_lens)
-                tok = sample_tokens_rowwise(pen_logits, row_keys, temp, tk, tp)
+                tok = sample_tokens_rowwise(pen_logits, row_keys, temp, tk,
+                                            tp, need_mask, need_sample)
                 # Append the sampled token only on the final chunk.
                 row_f = jnp.concatenate([row[1:], tok])
                 row = jnp.where(is_final[0] > 0, row_f, row)
                 recent = recent.at[slot_id[0]].set(row)
                 return tok, kc, vc, recent
 
-            self._prefill_jits[("chunk", chunk)] = jax.jit(fn, donate_argnums=(4, 5, 6))
-        return self._prefill_jits[("chunk", chunk)]
+            self._prefill_jits[("chunk", chunk, flags)] = jax.jit(
+                fn, donate_argnums=(4, 5, 6)
+            )
+        return self._prefill_jits[("chunk", chunk, flags)]
 
     def _dispatch_prefill_sp(self, T, tokens, lens, slot_ids, pt_rows,
                              temp, tk, tp, pen, pres, freq, seeds, key):
-        fn = self._get_sp_prefill_jit(T)
+        fn = self._get_sp_prefill_jit(
+            T, sampling_flags(temp, tk, tp, pen, pres, freq)
+        )
         return fn(self.params, jnp.asarray(tokens), jnp.asarray(lens),
                   self.kc, self.vc, self.recent, jnp.asarray(slot_ids),
                   jnp.asarray(pt_rows), jnp.asarray(temp), jnp.asarray(tk),
                   jnp.asarray(tp), jnp.asarray(pen), jnp.asarray(pres),
                   jnp.asarray(freq), jnp.asarray(seeds), key)
 
-    def _get_sp_prefill_jit(self, T: int):
+    def _get_sp_prefill_jit(self, T: int, flags=(True, True, True)):
         """Sequence-parallel long-prompt prefill: the whole prompt in one
         forward with activations sharded along T over the mesh "seq" axis
         (ring attention rotates K/V blocks over ICI —
         models/llama.py:forward_prefill_sp), then the returned K/V stacks
         scatter into the slot's pages. One compile per padded length T."""
-        key_ = ("sp", T)
+        key_ = ("sp", T, flags)
         if key_ not in self._prefill_jits:
             cfg, ps, mesh = self.cfg, self.ecfg.page_size, self.mesh
+            need_pen, need_mask, need_sample = flags
 
             def fn(params, tokens, seq_lens, kc, vc, recent, slot_ids, pt,
                    temp, tk, tp, pen, pres, freq, seeds, key):
@@ -374,9 +394,11 @@ class ModelRuntime:
                     tokens, jnp.clip(idx, 0, T - 1), axis=1
                 )
                 rows = jnp.where(idx >= 0, gathered, -1)
-                pen_logits = apply_penalties(logits, rows, pen, pres, freq)
+                pen_logits = maybe_apply_penalties(logits, rows, pen, pres,
+                                                   freq, need_pen)
                 row_keys = per_row_keys(key, seeds, seq_lens)
-                tok = sample_tokens_rowwise(pen_logits, row_keys, temp, tk, tp)
+                tok = sample_tokens_rowwise(pen_logits, row_keys, temp, tk,
+                                            tp, need_mask, need_sample)
                 rows = jnp.concatenate([rows[:, 1:], tok[:, None]], axis=1)
                 recent = recent.at[slot_ids].set(rows)
                 return tok, kc, vc, recent
@@ -428,10 +450,12 @@ class ModelRuntime:
         self.prefill_latency_ms = (time.monotonic() - t0) * 1e3
         self._install_slot(slot, req, n, int(np.asarray(tok)[0]), core)
 
-    def _get_decode_jit(self, k_steps: int):
-        if k_steps not in self._decode_jits:
+    def _get_decode_jit(self, k_steps: int, flags=(True, True, True)):
+        key_ = (k_steps, flags)
+        if key_ not in self._decode_jits:
             cfg, ps = self.cfg, self.ecfg.page_size
             attn_impl = self.attn_impl
+            need_pen, need_mask, need_sample = flags
 
             def fn(params, tokens, positions, kc, vc, recent, active, pt,
                    temp, tk, tp, pen, pres, freq, seeds, key):
@@ -444,8 +468,9 @@ class ModelRuntime:
                         attn_impl=attn_impl,
                     )
                     key, sub = jax.random.split(key)
-                    pen_logits = apply_penalties(logits, recent[:S], pen,
-                                                 pres, freq)
+                    pen_logits = maybe_apply_penalties(logits, recent[:S],
+                                                       pen, pres, freq,
+                                                       need_pen)
                     # Seeded streams fold in the position of the token being
                     # SAMPLED (positions holds the incoming token's slot):
                     # prefill folded n for the token at n, so the first
@@ -453,7 +478,8 @@ class ModelRuntime:
                     # consecutive sampling decisions share a key.
                     row_keys = per_row_keys(sub, seeds, positions + 1)
                     nxt = sample_tokens_rowwise(pen_logits, row_keys, temp,
-                                                tk, tp)
+                                                tk, tp, need_mask,
+                                                need_sample)
                     # Roll the sampled token into ACTIVE slots' rings only —
                     # reserved (mid-chunked-prefill) slots must not collect
                     # garbage tokens.
@@ -470,8 +496,8 @@ class ModelRuntime:
                 )
                 return toks, kc, vc, recent  # toks: [K, S]
 
-            self._decode_jits[k_steps] = jax.jit(fn, donate_argnums=(3, 4, 5))
-        return self._decode_jits[k_steps]
+            self._decode_jits[key_] = jax.jit(fn, donate_argnums=(3, 4, 5))
+        return self._decode_jits[key_]
 
     # -- slot lifecycle ----------------------------------------------------
     def _finish_slot(
@@ -811,7 +837,10 @@ class ModelRuntime:
             # that compiles but faults at runtime goes down the normal
             # _fail_runtime -> rebuild path like any other device error.
             try:
-                self._get_decode_jit(k_steps).lower(
+                probe_flags = sampling_flags(self.temp, self.top_k,
+                                             self.top_p, self.rep_pen,
+                                             self.pres_pen, self.freq_pen)
+                self._get_decode_jit(k_steps, probe_flags).lower(
                     self.params, jnp.asarray(self.last_tokens),
                     jnp.asarray(self.seq_lens), self.kc, self.vc,
                     self.recent, jnp.asarray(active_mask),

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import dataclasses
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
@@ -124,35 +126,81 @@ def apply_penalties(
     return out - presence[:, None] * seen.astype(logits.dtype)
 
 
+# Candidate pool for top-k / top-p thresholds. A full [B, V] sort per
+# decode step is the single most expensive op in the sampler (V is 128K
+# for Llama-3); lax.top_k over a fixed pool is ~an order of magnitude
+# cheaper. Requests asking top_k > MAX_TOPK are clamped, and a nucleus
+# wider than MAX_TOPK candidates degrades to top-MAX_TOPK — same spirit
+# as llama.cpp's default top_k=40 pre-filter that the reference inherits
+# via Ollama. Probabilities use the FULL softmax normalizer (logsumexp
+# over all logits), so within the pool the nucleus cutoff is exact.
+MAX_TOPK = 256
+
+
 def _masked_scaled_logits(
     logits: jnp.ndarray,  # [B, V] float32
     temperature: jnp.ndarray,  # [B]
     top_k: jnp.ndarray,  # [B] int32 (0 = off)
     top_p: jnp.ndarray,  # [B]
+    need_mask: bool = True,
 ):
-    """(masked scaled logits, greedy argmax) shared by both samplers."""
+    """(masked scaled logits, greedy argmax) shared by both samplers.
+    `need_mask` is a trace-time flag: when the host knows no row in the
+    batch uses top-k/top-p, the threshold computation is skipped."""
     B, V = logits.shape
     greedy = jnp.argmax(logits, axis=-1)
 
     safe_t = jnp.where(temperature > 0, temperature, 1.0)
     scaled = logits / safe_t[:, None]
+    if not need_mask:
+        return scaled, greedy
 
-    # top-k mask: keep the k largest (k==0 -> keep all).
-    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B, V] descending
-    k_idx = jnp.clip(top_k - 1, 0, V - 1)
-    kth = jnp.take_along_axis(sorted_desc, k_idx[:, None], axis=-1)  # [B,1]
+    K = min(MAX_TOPK, V)
+    vals, _ = jax.lax.top_k(scaled, K)  # [B, K] descending
+
+    # top-k mask: keep the k largest (k==0 -> keep all; k > K clamps).
+    k_idx = jnp.clip(top_k - 1, 0, K - 1)
+    kth = jnp.take_along_axis(vals, k_idx[:, None], axis=-1)  # [B,1]
     topk_mask = jnp.where((top_k > 0)[:, None], scaled >= kth, True)
 
-    # top-p (nucleus) mask over the sorted distribution.
-    probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
-    cum = jnp.cumsum(probs_sorted, axis=-1)
-    # keep tokens whose prob >= the threshold prob at the nucleus boundary
-    cutoff_count = jnp.sum(cum - probs_sorted < top_p[:, None], axis=-1)  # >=1
-    cut_idx = jnp.clip(cutoff_count - 1, 0, V - 1)
-    p_kth = jnp.take_along_axis(sorted_desc, cut_idx[:, None], axis=-1)
+    # top-p (nucleus) mask: exact probabilities for the pool via the full
+    # normalizer; cutoff at the last token whose cumulative mass (before
+    # itself) is below top_p.
+    log_z = jax.scipy.special.logsumexp(scaled, axis=-1, keepdims=True)
+    probs = jnp.exp(vals - log_z)  # [B, K]
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_count = jnp.sum(cum - probs < top_p[:, None], axis=-1)  # >=1
+    cut_idx = jnp.clip(cutoff_count - 1, 0, K - 1)
+    p_kth = jnp.take_along_axis(vals, cut_idx[:, None], axis=-1)
     topp_mask = jnp.where((top_p < 1.0)[:, None], scaled >= p_kth, True)
 
     return jnp.where(topk_mask & topp_mask, scaled, -jnp.inf), greedy
+
+
+def sampling_flags(temp, top_k, top_p, repeat, presence, frequency):
+    """(need_penalties, need_mask, need_sample) from HOST-side parameter
+    arrays. These are trace-time specialization flags: the engine keys its
+    compiled step variants on them, so an all-greedy batch (the common
+    /api/generate default) runs argmax only — no [B, V] scatter-counts, no
+    top-k scan, no categorical draw. Each flag covers the whole batch;
+    mixed batches take the general path for everyone."""
+    return (
+        bool(np.any(np.asarray(repeat) != 1.0)
+             or np.any(np.asarray(presence) != 0.0)
+             or np.any(np.asarray(frequency) != 0.0)),
+        bool(np.any(np.asarray(top_k) > 0)
+             or np.any(np.asarray(top_p) < 1.0)),
+        bool(np.any(np.asarray(temp) > 0)),
+    )
+
+
+def maybe_apply_penalties(logits, recent, repeat, presence, frequency,
+                          need_penalties: bool = True):
+    """apply_penalties, skipped entirely at trace time when the host knows
+    every row is neutral (repeat==1, presence==frequency==0)."""
+    if not need_penalties:
+        return logits
+    return apply_penalties(logits, recent, repeat, presence, frequency)
 
 
 def sample_tokens(
@@ -161,9 +209,14 @@ def sample_tokens(
     temperature: jnp.ndarray,  # [B]
     top_k: jnp.ndarray,  # [B] int32 (0 = off)
     top_p: jnp.ndarray,  # [B]
+    need_mask: bool = True,
+    need_sample: bool = True,
 ) -> jnp.ndarray:
     """Vectorized per-sequence sampling. Greedy where temperature == 0."""
-    masked, greedy = _masked_scaled_logits(logits, temperature, top_k, top_p)
+    masked, greedy = _masked_scaled_logits(logits, temperature, top_k, top_p,
+                                           need_mask)
+    if not need_sample:
+        return greedy.astype(jnp.int32)
     sampled = jax.random.categorical(key, masked, axis=-1)
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
 
@@ -191,8 +244,13 @@ def sample_tokens_rowwise(
     temperature: jnp.ndarray,  # [B]
     top_k: jnp.ndarray,  # [B] int32 (0 = off)
     top_p: jnp.ndarray,  # [B]
+    need_mask: bool = True,
+    need_sample: bool = True,
 ) -> jnp.ndarray:
     """sample_tokens with an independent key per row (per-request seeds)."""
-    masked, greedy = _masked_scaled_logits(logits, temperature, top_k, top_p)
+    masked, greedy = _masked_scaled_logits(logits, temperature, top_k, top_p,
+                                           need_mask)
+    if not need_sample:
+        return greedy.astype(jnp.int32)
     sampled = jax.vmap(lambda k, l: jax.random.categorical(k, l))(row_keys, masked)
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
