@@ -194,8 +194,14 @@ def main() -> int:
             rt.pending_prefill.append(req)
             t0 = time.monotonic()
             while rt.pending_prefill or rt.chunking:
-                rt.step_prefill(core)
-                rt.step_chunk(core)
+                progressed = rt.step_prefill(core)
+                progressed = rt.step_chunk(core) or progressed
+                if not progressed and not rt.chunking:
+                    # step_prefill returned False with the request still
+                    # pending (page allocation failed): no iteration will
+                    # ever succeed — surface the structured error instead
+                    # of spinning forever.
+                    break
             ms = (time.monotonic() - t0) * 1e3
             installed = any(r is req for r in rt.slot_req)
             for s, r in enumerate(rt.slot_req):

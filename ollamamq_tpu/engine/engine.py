@@ -40,7 +40,7 @@ import jax.numpy as jnp
 
 from ollamamq_tpu.config import EngineConfig, ModelConfig, get_model_config, smart_match
 from ollamamq_tpu.core import MQCore, Fairness, Family
-from ollamamq_tpu.core.mqcore import StuckQueue
+from ollamamq_tpu.core.mqcore import BlockedError, StuckQueue
 from ollamamq_tpu.engine import kv_cache as kvc
 from ollamamq_tpu.engine.request import FinishReason, Request, StreamItem
 from ollamamq_tpu.engine.tokenizer import load_tokenizer
@@ -227,9 +227,10 @@ class ModelRuntime:
         return sum(r is not None for r in self.slot_req)
 
     # -- submission --------------------------------------------------------
-    def submit(self, req: Request) -> None:
+    def submit(self, req: Request) -> bool:
         req._inc_decode = self.tokenizer.make_incremental_decoder()
         self.pending_prefill.append(req)
+        return True
 
     # -- compiled steps ----------------------------------------------------
     def _bucket_for(self, n: int) -> int:
@@ -981,8 +982,9 @@ class EncoderRuntime:
     def active_count(self) -> int:
         return 0
 
-    def submit(self, req: Request) -> None:
+    def submit(self, req: Request) -> bool:
         self.pending.append(req)
+        return True
 
     def check_cancellations(self, core: MQCore) -> None:
         # Late blocked re-check (see ModelRuntime.check_cancellations).
@@ -1034,7 +1036,9 @@ class EncoderRuntime:
         bucket = 32
         while bucket < longest:
             bucket *= 2
-        B = 8  # fixed batch bucket => one compile
+        # Two batch buckets per length bucket (like prefill): B=1 so a lone
+        # embedding request doesn't pay 8x compute, B=8 for bursts.
+        B = 1 if len(batch) == 1 else 8
         tokens = np.zeros((B, bucket), np.int32)
         lens = np.zeros((B,), np.int32)
         for i, r in enumerate(batch):
@@ -1068,6 +1072,40 @@ class EncoderRuntime:
         }
 
 
+def build_model_runtimes(name, cfg, engine_cfg, mesh, dtype, checkpoint_path,
+                         model_cls, encoder_cls):
+    """Replica list for one model — THE construction path, shared by
+    TPUEngine.load_model and the SPMD worker (engine/spmd.py). Under SPMD
+    every host must build byte-identical computations, so there is exactly
+    one copy of the dp-submesh / preloaded-params / encoder branching.
+
+    dp generative replicas each land on their own slice of the mesh's
+    data axis (a [1, sp, tp] submesh): N param copies + KV pools serving
+    concurrently — the reference's "one request per backend, N backends"
+    scale-out story with backends = mesh slices. The checkpoint is
+    read/parsed once and shared host-side across replicas."""
+    from jax.sharding import Mesh
+
+    if cfg.is_encoder:
+        return [encoder_cls(name, cfg, engine_cfg, mesh=mesh,
+                            checkpoint_path=checkpoint_path, dtype=dtype)]
+    if engine_cfg.dp > 1 and mesh is not None:
+        host_params = weights.load_params(
+            cfg, checkpoint_path, seed=engine_cfg.seed, dtype=dtype
+        )
+        reps = [
+            model_cls(name, cfg, engine_cfg,
+                      mesh=Mesh(mesh.devices[r:r + 1], mesh.axis_names),
+                      checkpoint_path=checkpoint_path, dtype=dtype,
+                      preloaded_params=host_params)
+            for r in range(engine_cfg.dp)
+        ]
+        del host_params  # replicas hold their own device copies
+        return reps
+    return [model_cls(name, cfg, engine_cfg, mesh=mesh,
+                      checkpoint_path=checkpoint_path, dtype=dtype)]
+
+
 class ReplicaSet:
     """Data parallelism as replica serving: dp independent ModelRuntimes for
     one model, each TP-sharded over its own slice of the mesh's data axis,
@@ -1093,12 +1131,16 @@ class ReplicaSet:
     def has_capacity(self) -> bool:
         return any(r.has_capacity() for r in self.replicas)
 
-    def submit(self, req: Request) -> None:
-        """Least-loaded replica wins; ties rotate after the previous pick."""
+    def submit(self, req: Request) -> bool:
+        """Least-loaded replica wins; ties rotate after the previous pick.
+        Returns False when NO replica has capacity (the admission gate
+        raced): the caller returns the request to the native queue — the
+        reference's wait-in-queue semantics (dispatcher.rs:467-473) —
+        instead of parking it on a full replica where it would jump the
+        fair-share order."""
         eligible = [i for i, r in enumerate(self.replicas) if r.has_capacity()]
-        if not eligible:  # capacity raced away; park on a LIVE least-loaded
-            eligible = [i for i, r in enumerate(self.replicas)
-                        if not r._failed] or list(range(len(self.replicas)))
+        if not eligible:
+            return False
         best = min(self._load(self.replicas[i]) for i in eligible)
         ties = {i for i in eligible if self._load(self.replicas[i]) == best}
         n = len(self.replicas)
@@ -1106,8 +1148,16 @@ class ReplicaSet:
             i = (self._last_idx + off) % n
             if i in ties:
                 self._last_idx = i
-                self.replicas[i].submit(req)
-                return
+                return self.replicas[i].submit(req)
+        return False
+
+    def force_submit(self, req: Request) -> None:
+        """Place even with zero capacity (least-loaded live replica): for
+        requests the native queue can't hold back (empty model name)."""
+        live = ([i for i, r in enumerate(self.replicas) if not r._failed]
+                or list(range(len(self.replicas))))
+        best = min(live, key=lambda i: self._load(self.replicas[i]))
+        self.replicas[best].submit(req)
 
     # -- aggregate runtime surface (registry / health / TUI / app) ---------
     @property
@@ -1183,8 +1233,21 @@ class TPUEngine:
         self._cond = threading.Condition()
         self._running = False
         self._thread: Optional[threading.Thread] = None
+        # Deferred engine-thread calls (call_on_loop): work that must run in
+        # order with device dispatches — e.g. SPMD control broadcasts, which
+        # would race the dispatch broadcast stream from any other thread.
+        self._engine_calls: collections.deque = collections.deque()
         self.health = None
         self.started_at = time.time()
+        # CPU-gloo can't run two cross-host computations concurrently: XLA's
+        # CPU thread pool executes them in nondeterministic order and their
+        # collective ops interleave differently per process on the shared
+        # TCP pairs (observed as gloo size-mismatch aborts). On TPU each
+        # replica's collectives ride its own disjoint ICI clique, so the
+        # dispatch/collect overlap is safe — serialize only multi-host CPU.
+        self._serialize_multihost = (
+            jax.process_count() > 1 and jax.default_backend() == "cpu"
+        )
         # Failure recovery: runtimes marked failed are rebuilt (weights
         # reloaded) on this cadence instead of requiring a process restart.
         self._model_sources: Dict[str, Optional[str]] = {}
@@ -1206,31 +1269,11 @@ class TPUEngine:
         if name in self.runtimes:
             return
         self._model_sources[name] = checkpoint_path
-        cls = self.encoder_runtime_class if cfg.is_encoder else self.runtime_class
-        if not cfg.is_encoder and self.ecfg.dp > 1 and self.mesh is not None:
-            # dp replicas, each on its own slice of the mesh's data axis
-            # (a [1, sp, tp] submesh): N params copies + KV pools serving
-            # concurrently — the reference's "one request per backend, N
-            # backends" scale-out story with backends = mesh slices.
-            from jax.sharding import Mesh
-
-            host_params = weights.load_params(
-                cfg, checkpoint_path, seed=self.ecfg.seed, dtype=self.dtype
-            )
-            reps = [
-                cls(name, cfg, self.ecfg,
-                    mesh=Mesh(self.mesh.devices[r:r + 1], self.mesh.axis_names),
-                    checkpoint_path=checkpoint_path, dtype=self.dtype,
-                    preloaded_params=host_params)
-                for r in range(self.ecfg.dp)
-            ]
-            del host_params  # replicas hold their own device copies
-            self.runtimes[name] = ReplicaSet(reps)
-        else:
-            self.runtimes[name] = cls(
-                name, cfg, self.ecfg, mesh=self.mesh,
-                checkpoint_path=checkpoint_path, dtype=self.dtype,
-            )
+        reps = build_model_runtimes(
+            name, cfg, self.ecfg, self.mesh, self.dtype, checkpoint_path,
+            self.runtime_class, self.encoder_runtime_class,
+        )
+        self.runtimes[name] = reps[0] if len(reps) == 1 else ReplicaSet(reps)
         log.info("loaded model %s (%.1f MB params)", name,
                  self.runtimes[name].param_bytes / 1e6)
         self.notify()
@@ -1335,19 +1378,70 @@ class TPUEngine:
         with self._cond:
             self._cond.notify()
 
-    def resolve_runtime(self, model: str):
+    def call_on_loop(self, fn, timeout: float = 900.0):
+        """Run `fn` on the engine thread, serialized with device dispatches,
+        and return its result (raising what it raised). When the loop isn't
+        running — or we ARE the engine thread — runs inline. The generous
+        default timeout covers weight reloads behind queued work."""
+        if not self._running or threading.current_thread() is self._thread:
+            return fn()
+        ev = threading.Event()
+        box: dict = {}
+        entry = (fn, ev, box)
+        self._engine_calls.append(entry)
+        self.notify()
+        if not self._running:
+            # stop() may have drained the queue just before our append; if
+            # our entry is still there, nothing will ever run it — reclaim
+            # and run inline.
+            try:
+                self._engine_calls.remove(entry)
+            except ValueError:
+                pass  # loop or stop() took it; the event will fire
+            else:
+                return fn()
+        if not ev.wait(timeout):
+            raise TimeoutError("engine-loop call timed out")
+        if "err" in box:
+            raise box["err"]
+        return box.get("ret")
+
+    def _drain_engine_calls(self) -> None:
+        while self._engine_calls:
+            fn, ev, box = self._engine_calls.popleft()
+            try:
+                box["ret"] = fn()
+            except BaseException as e:  # delivered to the waiting thread
+                box["err"] = e
+            ev.set()
+
+    def resolve_runtime(self, model: str, kind: str = "generate"):
         if not model:
-            # No model requested: any LIVE generative runtime (reference
-            # lets Unknown-family tasks hit any online backend,
-            # dispatcher.rs:453-461 — offline ones are skipped).
+            # No model requested: any LIVE runtime of the right KIND
+            # (reference lets Unknown-family tasks hit any online backend,
+            # dispatcher.rs:453-461 — offline ones are skipped). The kind
+            # filter keeps a generative request off an EncoderRuntime when
+            # only encoders are loaded: it would "finish" with an embedding
+            # and no tokens.
+            want_encoder = kind == "embed"
+
+            def kind_ok(rt):
+                return isinstance(rt, EncoderRuntime) == want_encoder
+
             for rt in self.runtimes.values():
-                if isinstance(rt, ReplicaSet) and any(
-                    not r._failed for r in rt.replicas
-                ):
+                if isinstance(rt, ReplicaSet) and kind_ok(rt.replicas[0]) \
+                        and any(not r._failed for r in rt.replicas):
                     return rt
-                if isinstance(rt, ModelRuntime) and not rt._failed:
+                if isinstance(rt, (ModelRuntime, EncoderRuntime)) \
+                        and kind_ok(rt) and not rt._failed:
                     return rt
-            return next(iter(self.runtimes.values()), None)
+            # Everything of the right kind is failed (mid-recovery): pick
+            # one anyway — the request parks on it and drains post-reload.
+            for rt in self.runtimes.values():
+                probe = rt.replicas[0] if isinstance(rt, ReplicaSet) else rt
+                if kind_ok(probe):
+                    return rt
+            return None
         key = smart_match(model, self.runtimes.keys())
         return self.runtimes[key] if key is not None else None
 
@@ -1370,6 +1464,13 @@ class TPUEngine:
         if self._thread:
             self._thread.join(timeout=10)
             self._thread = None
+        # Fail any deferred engine-thread calls that raced the shutdown —
+        # their waiters would otherwise block until the call_on_loop
+        # timeout.
+        while self._engine_calls:
+            _fn, ev, box = self._engine_calls.popleft()
+            box["err"] = RuntimeError("engine stopped")
+            ev.set()
         if self.health is not None:
             self.health.stop()
             self.health = None
@@ -1396,7 +1497,7 @@ class TPUEngine:
                 continue
             if req is None:
                 continue  # still within grace, not yet registered
-            rt = self.resolve_runtime(model)
+            rt = self.resolve_runtime(model, kind=req.kind)
             if rt is not None and not rt.has_capacity():
                 # Runtime full: put the Request back and retry later.
                 with self._pending_lock:
@@ -1451,14 +1552,43 @@ class TPUEngine:
             self.core.mark_dropped(user, started=False)
             req.finish(FinishReason.CANCELLED)
             return False
-        rt = self.resolve_runtime(model)
+        rt = self.resolve_runtime(model, kind=req.kind)
+        if rt is None and model:
+            # The native eligibility gate raced an evict: the model vanished
+            # between mq_next's model check and placement. Stuck-queue
+            # semantics (the reference parks requests whose backend is gone,
+            # dispatcher.rs:467-473) — put it back rather than erroring.
+            # Named models only: an empty model always passes the native
+            # gate, so requeueing it would spin.
+            return self._requeue(req, user, model)
         if rt is None:
             self.core.mark_dropped(user, started=False)
             req.finish(FinishReason.ERROR, error=f"model not loaded: {model}")
             return False
+        if not rt.submit(req):
+            if model:
+                # Replica capacity raced away between the admission gate
+                # and placement: wait-in-queue, same as the evict race
+                # above — the native gate holds it until capacity returns.
+                return self._requeue(req, user, model)
+            # Empty-model requests always pass the native gate, so a
+            # requeue would spin; park on the least-loaded live replica.
+            rt.force_submit(req)
         self.core.mark_started(user)
-        rt.submit(req)
         return True
+
+    def _requeue(self, req: Request, user: str, model: str) -> bool:
+        """Return a popped-but-unplaceable request to the native queue
+        (wait-don't-fail). Always returns False (nothing was placed)."""
+        try:
+            with self._pending_lock:
+                new_rid = self.core.enqueue(user, "", model)
+                req.req_id = new_rid
+                self.pending[new_rid] = req
+        except BlockedError:
+            self.core.mark_dropped(user, started=False)
+            req.finish(FinishReason.CANCELLED)
+        return False
 
     def _step_targets(self) -> List[object]:
         """Individually-steppable runtimes: replica sets flatten so each
@@ -1491,75 +1621,90 @@ class TPUEngine:
 
     def _loop(self) -> None:
         while self._running:
-            self._swap_rebuilt()
-            if (self._failed_runtimes
-                    and time.monotonic() - self._last_recover_attempt
-                    > self.recover_interval):
-                self._try_recover()
-            self._admit()
-            did_work = False
-            # Phase 1: prefills + decode DISPATCH for every runtime. JAX
-            # dispatch is async, so once runtime A's chunk is in flight the
-            # loop immediately dispatches runtime B's — dp replicas (and
-            # distinct models on disjoint submeshes) overlap on device.
-            handles: List[tuple] = []  # (rt, decode handle)
-            for rt in self._step_targets():
-                if getattr(rt, "_failed", False):
-                    continue
-                try:
-                    rt.check_cancellations(self.core)
-                    if isinstance(rt, ModelRuntime):
-                        # TTFT first: drain pending prefills into free slots.
-                        while rt.pending_prefill and rt.step_prefill(self.core):
-                            did_work = True
-                        # One chunk of any long-prompt prefill per tick,
-                        # interleaved with decode below.
-                        if rt.step_chunk(self.core):
-                            did_work = True
-                        if any(r is not None for r in rt.slot_req):
-                            # Short decode chunks (k=1) keep TTFT low ONLY
-                            # when an admission could actually land between
-                            # steps: pending work AND a free seat, or a
-                            # chunked prefill to interleave. A saturated
-                            # batch with a deep backlog must run the full
-                            # fused chunk — per-step dispatch latency (the
-                            # TPU tunnel round trip) would otherwise gate
-                            # every token under exactly the 64-user load
-                            # the engine is built for.
-                            # Scoped to work THIS runtime could serve:
-                            # backlog parked for another (or evicted) model
-                            # must not hold a healthy runtime at k=1.
-                            waiting = bool(rt.pending_prefill) or bool(
-                                self.core.queued_matching(rt.name)
-                            )
-                            can_admit = waiting and rt.has_capacity()
-                            k = (1 if (can_admit or rt.chunking)
-                                 else self.ecfg.decode_steps_per_iter)
-                            h = rt.step_decode_dispatch(self.core, k_steps=k)
-                            if h is not None:
+            try:
+                self._loop_once()
+            except Exception:
+                # The engine thread must never die: a control-plane bug
+                # (admission, recovery bookkeeping) would otherwise stop
+                # ALL serving with requests parked forever. Runtime step
+                # errors are already handled per-runtime inside _loop_once.
+                log.exception("engine loop iteration failed; continuing")
+                time.sleep(0.1)
+
+    def _loop_once(self) -> None:
+        self._drain_engine_calls()
+        self._swap_rebuilt()
+        if (self._failed_runtimes
+                and time.monotonic() - self._last_recover_attempt
+                > self.recover_interval):
+            self._try_recover()
+        self._admit()
+        did_work = False
+        # Phase 1: prefills + decode DISPATCH for every runtime. JAX
+        # dispatch is async, so once runtime A's chunk is in flight the
+        # loop immediately dispatches runtime B's — dp replicas (and
+        # distinct models on disjoint submeshes) overlap on device.
+        handles: List[tuple] = []  # (rt, decode handle)
+        for rt in self._step_targets():
+            if getattr(rt, "_failed", False):
+                continue
+            try:
+                rt.check_cancellations(self.core)
+                if isinstance(rt, ModelRuntime):
+                    # TTFT first: drain pending prefills into free slots.
+                    while rt.pending_prefill and rt.step_prefill(self.core):
+                        did_work = True
+                    # One chunk of any long-prompt prefill per tick,
+                    # interleaved with decode below.
+                    if rt.step_chunk(self.core):
+                        did_work = True
+                    if any(r is not None for r in rt.slot_req):
+                        # Short decode chunks (k=1) keep TTFT low ONLY
+                        # when an admission could actually land between
+                        # steps: pending work AND a free seat, or a
+                        # chunked prefill to interleave. A saturated
+                        # batch with a deep backlog must run the full
+                        # fused chunk — per-step dispatch latency (the
+                        # TPU tunnel round trip) would otherwise gate
+                        # every token under exactly the 64-user load
+                        # the engine is built for.
+                        # Scoped to work THIS runtime could serve:
+                        # backlog parked for another (or evicted) model
+                        # must not hold a healthy runtime at k=1.
+                        waiting = bool(rt.pending_prefill) or bool(
+                            self.core.queued_matching(rt.name)
+                        )
+                        can_admit = waiting and rt.has_capacity()
+                        k = (1 if (can_admit or rt.chunking)
+                             else self.ecfg.decode_steps_per_iter)
+                        h = rt.step_decode_dispatch(self.core, k_steps=k)
+                        if h is not None:
+                            if self._serialize_multihost:
+                                rt.step_decode_collect(h, self.core)
+                            else:
                                 handles.append((rt, h))
-                            did_work = True
-                    else:
-                        if rt.has_work():
-                            rt.step(self.core)
-                            did_work = True
-                except Exception:
-                    log.exception("runtime %s step failed", rt.name)
-                    self._kill_runtime(rt)
-                    did_work = True
-            # Phase 2: collect every in-flight chunk. Device errors in the
-            # async computation surface here, not at dispatch.
-            for rt, h in handles:
-                if getattr(rt, "_failed", False):
-                    continue
-                try:
-                    rt.step_decode_collect(h, self.core)
-                except Exception:
-                    log.exception("runtime %s decode collect failed", rt.name)
-                    self._kill_runtime(rt)
-            if not did_work:
-                with self._cond:
-                    self._cond.wait(timeout=0.05)
+                        did_work = True
+                else:
+                    if rt.has_work():
+                        rt.step(self.core)
+                        did_work = True
+            except Exception:
+                log.exception("runtime %s step failed", rt.name)
+                self._kill_runtime(rt)
+                did_work = True
+        # Phase 2: collect every in-flight chunk. Device errors in the
+        # async computation surface here, not at dispatch.
+        for rt, h in handles:
+            if getattr(rt, "_failed", False):
+                continue
+            try:
+                rt.step_decode_collect(h, self.core)
+            except Exception:
+                log.exception("runtime %s decode collect failed", rt.name)
+                self._kill_runtime(rt)
+        if not did_work:
+            with self._cond:
+                self._cond.wait(timeout=0.05)
 
     def _try_recover(self) -> None:
         """Kick off background rebuilds of failed runtimes. The reference's
@@ -1569,20 +1714,20 @@ class TPUEngine:
         device state is gone. The reload runs OFF the engine thread so
         healthy runtimes keep serving; _swap_rebuilt installs the result."""
         self._last_recover_attempt = time.monotonic()
-        if jax.process_count() > 1:
-            # SPMD workers replay broadcast dispatches against their own KV
-            # state; rebuilding only the primary's runtime would desync
-            # them. Multi-host recovery needs a reload opcode — until then,
-            # leave the runtime failed (operator restarts the pod).
-            return
         for rt in list(self._failed_runtimes):
             if id(rt) in self._recovering:
                 continue
             self._recovering.add(id(rt))
-            threading.Thread(
-                target=self._rebuild_runtime, args=(rt,),
-                name=f"recover-{rt.name}", daemon=True,
-            ).start()
+            self._start_rebuild(rt)
+
+    def _start_rebuild(self, rt) -> None:
+        """Rebuild seam: background thread here; the SPMD engine overrides
+        to broadcast a reload opcode and rebuild inline on the engine thread
+        (ordered with the dispatch broadcast stream)."""
+        threading.Thread(
+            target=self._rebuild_runtime, args=(rt,),
+            name=f"recover-{rt.name}", daemon=True,
+        ).start()
 
     def _rebuild_runtime(self, rt) -> None:
         """(background thread) Build a replacement runtime; post it for the
@@ -1616,6 +1761,7 @@ class TPUEngine:
         for rt, fresh in items:
             if hasattr(rt, "spmd_index"):
                 fresh.spmd_index = rt.spmd_index
+                fresh.spmd_replica = getattr(rt, "spmd_replica", 0)
             cur = self.runtimes.get(rt.name)
             if isinstance(cur, ReplicaSet) and rt in cur.replicas:
                 cur.replicas[cur.replicas.index(rt)] = fresh

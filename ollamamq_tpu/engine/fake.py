@@ -46,8 +46,9 @@ class FakeRuntime:
     def active_count(self) -> int:
         return len(self.active)
 
-    def submit(self, req: Request) -> None:
+    def submit(self, req: Request) -> bool:
         self.pending_prefill.append(req)
+        return True
 
     def check_cancellations(self, core) -> None:
         for req in list(self.active):

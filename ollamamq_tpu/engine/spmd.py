@@ -7,27 +7,64 @@ chips), and each jitted step executes on all hosts with XLA collectives
 over ICI/DCN doing the cross-chip movement.
 
 Control plane: the primary host (process 0) owns the scheduler, HTTP
-front, and all admission decisions. Before every device step it
-broadcasts a "step plan" via `multihost_utils.broadcast_one_to_all` in
-two phases — a fixed-shape header (opcode + static dims), then the
-op-specific payload (token ids, page tables, sampling params, raw RNG
-key) — so both sides always issue matching collectives. Workers sit in
-`run_worker`, receive plans, and issue the SAME jit call with their
-local shards. Every value feeding the computation is broadcast, never
-recomputed locally, so all hosts trace and execute identical steps.
+front, and all admission decisions. Before every device step it ships a
+"step plan" — a fixed-shape header (opcode + static dims + routing
+ordinals) plus the op payload (token ids, page tables, sampling params,
+raw RNG key) — over the jax.distributed KV store as a monotonic key
+stream (`_Wire`). Workers sit in `run_worker`, long-poll the stream, and
+issue the SAME jit call with their local shards. Every value feeding the
+computation travels on the wire, never recomputed locally, so all hosts
+trace and execute identical steps. The control plane is deliberately
+gRPC, not a device collective: broadcasts would share the cross-host
+transport with model collectives (gloo pairs on CPU) and any reordering
+between the two corrupts the transport; coordinator traffic cannot.
 
-Opcode header (int32[4]: [op, a, b, model_ordinal]):
+Opcode header (int32[5]: [op, a, b, model_ordinal, replica_ordinal]):
     OP_SHUTDOWN = 0              -> workers exit (no payload)
     OP_PREFILL  = 1, a=bucket, b=B
     OP_CHUNK    = 2, a=chunk_size
     OP_DECODE   = 3, a=k_steps
     OP_ENCODE   = 4, a=B, b=bucket (embedding batch forward, stateless)
     OP_PREFILL_SP = 5, a=T (sequence-parallel long-prompt prefill)
+    OP_RELOAD   = 6              -> rebuild runtime [mi][ri] from pristine
+                                    config (multi-host failure recovery)
+    OP_LOAD     = 7, a=n_replicas; payload carries (name, ckpt) strings
+                                    (runtime /api/pull on every host)
+    OP_EVICT    = 8; payload carries name (runtime /api/delete)
+
+Data parallelism under SPMD: dp replicas each live on a slice of the
+mesh's data axis. make_mesh arranges the dp axis intra-host when
+process_count > 1, so every slice spans every process and each replica's
+jit is a valid multi-controller computation; the header's
+replica_ordinal routes the worker's replay to the right replica.
+
+Desync detection: after every replayed op, all hosts exchange a status
+flag OUT-OF-BAND via the jax.distributed KV store (`status_sync`) — a
+host-side barrier, deliberately NOT a device collective, so the report
+can't deadlock behind the very computation whose failure it reports. A
+worker whose replay failed has diverged KV state — serving on would emit
+silently-wrong tokens on every later tp-sharded step — so the primary
+fails the runtime LOUDLY and the recovery path broadcasts OP_RELOAD,
+rebuilding it on all hosts from pristine config. The sync is one small
+KV round-trip per dispatch (a fused k-step chunk, not a token);
+OLLAMAMQ_SPMD_STATUS_EVERY=N rate-limits it to every Nth data op
+(detection delayed ≤ N-1 dispatches) when even that is too much.
+
+Failure-class caveat: clean recovery covers failures where both sides
+ISSUED the step computation (device-side errors, post-dispatch state
+bugs — the common class). A worker that fails BEFORE issuing the jit
+(payload/shape protocol bug) leaves the primary's already-dispatched
+computation waiting on collectives with a missing peer; detection is
+still loud (the KV sync is out-of-band), the runtime is failed and
+requests error, but the orphaned computation is abandoned, not
+cancelled — on a real pod, prefer restarting the deployment after such
+a protocol error.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 from typing import Optional
 
 import numpy as np
@@ -46,14 +83,80 @@ OP_CHUNK = 2
 OP_DECODE = 3
 OP_ENCODE = 4
 OP_PREFILL_SP = 5
+OP_RELOAD = 6
+OP_LOAD = 7
+OP_EVICT = 8
 
 KEY_SHAPE = (2,)  # raw uint32 threefry key data
+NAME_LEN = 128  # utf-8 bytes, zero-padded, for OP_LOAD/OP_EVICT names
+PATH_LEN = 256  # utf-8 bytes for checkpoint paths ("" = None)
 
 
-def _bcast(tree):
-    from jax.experimental import multihost_utils
+def _status_every() -> int:
+    try:
+        # Clamped so the wire-key cleanup window (see _send) always covers
+        # the maximum worker lag.
+        return min(256, max(1, int(
+            os.environ.get("OLLAMAMQ_SPMD_STATUS_EVERY", "1"))))
+    except ValueError:
+        return 1
 
-    return multihost_utils.broadcast_one_to_all(tree)
+
+def _kv_client():
+    from jax._src import distributed
+
+    return distributed.global_state.client
+
+
+def _status_timeout_ms() -> int:
+    try:
+        return int(
+            float(os.environ.get("OLLAMAMQ_SPMD_STATUS_TIMEOUT", "900")) * 1000
+        )
+    except ValueError:
+        return 900_000
+
+
+def status_sync(ok: bool, seq: int) -> np.ndarray:
+    """Exchange one ok/fail flag per process via the jax.distributed
+    KV store + barrier; returns int32[nproc] (1 = that process's op
+    failed). Runs entirely HOST-side: it must never be a device
+    collective, because the failure being reported may be a computation
+    one side issued and the other didn't — mixing the report into the
+    device stream would deadlock behind that very computation.
+    Every process calls this at the same point in the op stream (`seq`
+    is the shared sync ordinal)."""
+    client = _kv_client()
+    n = jax.process_count()
+    pid = jax.process_index()
+    client.key_value_set(f"ollamamq/st/{seq}/{pid}", "ok" if ok else "fail")
+    client.wait_at_barrier(f"ollamamq/bar/{seq}", _status_timeout_ms())
+    flags = np.zeros(n, np.int32)
+    for i in range(n):
+        v = client.blocking_key_value_get(f"ollamamq/st/{seq}/{i}", 10_000)
+        flags[i] = 0 if v == "ok" else 1
+    # Everyone passed the PREVIOUS barrier before writing this sync's key,
+    # so our previous-sync key has been read by all — safe to clean up.
+    if seq > 0:
+        try:
+            client.key_value_delete(f"ollamamq/st/{seq - 1}/{pid}")
+        except Exception:
+            pass
+    return flags
+
+
+def _encode_str(s: Optional[str], n: int) -> np.ndarray:
+    raw = (s or "").encode("utf-8")
+    if len(raw) > n:
+        raise ValueError(f"string too long for SPMD wire field ({len(raw)} > {n})")
+    out = np.zeros((n,), np.int32)
+    out[: len(raw)] = np.frombuffer(raw, np.uint8)
+    return out
+
+
+def _decode_str(arr) -> str:
+    b = bytes(int(x) for x in np.asarray(arr).tolist() if int(x) != 0)
+    return b.decode("utf-8")
 
 
 def payload_spec(op, a, b, S, MP):
@@ -85,34 +188,185 @@ def payload_spec(op, a, b, S, MP):
     if op == OP_ENCODE:
         B, bucket = a, b
         return [((B, bucket), np.int32), ((B,), np.int32)]
+    if op in (OP_RELOAD, OP_SHUTDOWN):
+        return []
+    if op == OP_LOAD:
+        return [((NAME_LEN,), np.int32), ((PATH_LEN,), np.int32)]
+    if op == OP_EVICT:
+        return [((NAME_LEN,), np.int32)]
     raise ValueError(f"no payload spec for opcode {op}")
 
 
-def _send(op, a, b, index, values, S, MP):
+class _Wire:
+    """Primary→worker op stream over the jax.distributed KV store.
+
+    The op plan is CONTROL PLANE and deliberately travels over the
+    coordinator's gRPC channel, not as a device collective: a broadcast
+    jit shares the cross-host transport (gloo pairs on CPU) with model
+    collectives, and any concurrency between the two — including the
+    broadcast's own per-local-device reduction streams — interleaves ops
+    differently per process and aborts the transport. gRPC keys have no
+    ordering relationship with device collectives, so the control plane
+    can never corrupt the data plane.
+
+    Keys are `ollamamq/op/<seq>`: the primary writes them monotonically;
+    each worker long-polls its own cursor. The status-sync cadence bounds
+    worker lag to OLLAMAMQ_SPMD_STATUS_EVERY (≤256) ops, so the primary
+    deletes `seq - 1024` on every send and the stream stays O(1) keys."""
+
+    def __init__(self):
+        self.seq = 0
+
+
+_wire = _Wire()
+
+_HDR = 5 * 4  # int32[5] header bytes
+
+
+def _pack_payload(cast) -> bytes:
+    if not cast:
+        return b""
+    return b"".join(np.ascontiguousarray(v).tobytes() for v in cast)
+
+
+def _unpack_payload(raw: bytes, spec):
+    out = []
+    off = 0
+    for shape, dt in spec:
+        nb = int(np.prod(shape)) * np.dtype(dt).itemsize
+        out.append(np.frombuffer(raw[off:off + nb], dt).reshape(shape))
+        off += nb
+    return tuple(out)
+
+
+def _send(op, a, b, index, replica, values, S, MP):
     spec = payload_spec(op, a, b, S, MP)
     assert len(values) == len(spec)
     cast = []
     for v, (shape, dt) in zip(values, spec):
         v = np.asarray(v, dt)
-        # Shape drift would desync the broadcast tree across hosts with an
-        # opaque cross-host error; fail at the send site instead.
+        # Shape drift would desync the wire decode on workers with an
+        # opaque error; fail at the send site instead.
         assert v.shape == shape, (op, v.shape, shape)
         cast.append(v)
-    _bcast(np.asarray([op, a, b, index], np.int32))
-    _bcast(tuple(cast))
+    header = np.asarray([op, a, b, index, replica], np.int32).tobytes()
+    client = _kv_client()
+    client.key_value_set_bytes(f"ollamamq/op/{_wire.seq}",
+                               header + _pack_payload(cast))
+    old = _wire.seq - 1024
+    _wire.seq += 1
+    if old >= 0:
+        try:
+            client.key_value_delete(f"ollamamq/op/{old}")
+        except Exception:
+            pass
 
 
-def _recv(op, a, b, S, MP):
-    spec = payload_spec(op, a, b, S, MP)
-    return _bcast(tuple(np.zeros(shape, dt) for shape, dt in spec))
+def _recv_op(seq: int, timeout_ms: int = 60_000):
+    """Worker side: block for op `seq`; returns (header int32[5], raw
+    payload bytes). Retries on poll timeout — an idle engine sends
+    nothing for arbitrarily long."""
+    client = _kv_client()
+    while True:
+        try:
+            blob = client.blocking_key_value_get_bytes(
+                f"ollamamq/op/{seq}", timeout_ms
+            )
+            break
+        except Exception as e:
+            if "DEADLINE_EXCEEDED" in str(e) or "deadline" in str(e).lower():
+                continue
+            raise
+    header = np.frombuffer(blob[:_HDR], np.int32)
+    return header, blob[_HDR:]
 
 
 def broadcast_shutdown() -> None:
     """Release worker hosts. Sent exactly ONCE per deployment (the worker
-    loop exits on the first shutdown header; further broadcasts would have
-    no receiver and deadlock the sender)."""
+    loop exits on the first shutdown header)."""
     if jax.process_count() > 1:
-        _bcast(np.asarray([OP_SHUTDOWN, 0, 0, 0], np.int32))
+        _send(OP_SHUTDOWN, 0, 0, 0, 0, (), 0, 0)
+
+
+class _SyncBus:
+    """Global barrier ordinal for status syncs. Sync points derive
+    deterministically from the shared op stream, so every host executes
+    the same syncs in the same order and `seq` stays aligned without any
+    extra wire traffic; barrier ids are never reused."""
+
+    def __init__(self):
+        self.seq = 0
+
+    def sync(self, ok: bool) -> np.ndarray:
+        flags = status_sync(ok, self.seq)
+        self.seq += 1
+        return flags
+
+
+_bus = _SyncBus()
+
+
+class _OpCadence:
+    """Per-RUNTIME data-op counter for the status-sync cadence. One
+    instance lives on each SPMD runtime (primary) / worker replica, so a
+    carried-forward off-cadence failure is always reported at a sync
+    belonging to the SAME runtime — never attributed to whichever other
+    runtime happened to dispatch next (that would reload the healthy one
+    and leave the diverged one serving). Replays mirror dispatches
+    per-runtime, so both sides' counts agree; a reload builds a fresh
+    runtime and therefore a fresh zeroed cadence on every host."""
+
+    def __init__(self):
+        self.count = 0
+        self._pending_fail = False  # off-cadence failure carried forward
+
+    def after_op(self, ok: bool) -> Optional[np.ndarray]:
+        self.count += 1
+        # An off-cadence failure can't sync alone — the other hosts aren't
+        # at a sync point. Carry it to this runtime's next scheduled sync
+        # (detection delay ≤ every-1 of ITS ops). Default (every=1) syncs
+        # every op.
+        if self.count % _status_every() != 0:
+            self._pending_fail = self._pending_fail or not ok
+            return None
+        flags = _bus.sync(ok and not self._pending_fail)
+        self._pending_fail = False
+        return flags
+
+
+def _raise_on_worker_failure(flags: Optional[np.ndarray], name: str) -> None:
+    if flags is not None and flags.any():
+        bad = np.nonzero(flags)[0].tolist()
+        raise RuntimeError(
+            f"SPMD worker host(s) {bad} failed replaying a dispatch for "
+            f"{name}; KV state diverged — failing runtime for reload"
+        )
+
+
+def _mirrored_dispatch(rt, op, a, b, values, dispatch):
+    """Ship the plan, run the local dispatch, then join this runtime's
+    status sync. The status sync runs even when the local dispatch raised —
+    skipping it would strand the other hosts at the barrier. Shared by the
+    generative and encoder SPMD runtimes so the sync protocol can't drift
+    between them."""
+    _send(op, a, b, rt.spmd_index, rt.spmd_replica, values,
+          rt.ecfg.max_slots, rt.ecfg.max_pages_per_seq)
+    ok = False
+    try:
+        out = dispatch()
+        if _serialize_multihost():
+            # Every output, not just the ones the caller materializes:
+            # a trailing collective (e.g. a reshard on the KV-cache
+            # output path that doesn't feed the sampled tokens) still
+            # in flight when the next broadcast hits the shared gloo
+            # context would interleave and abort the pair.
+            jax.block_until_ready(out)
+        ok = True
+        return out
+    finally:
+        flags = rt._cadence.after_op(ok)
+        if ok:
+            _raise_on_worker_failure(flags, rt.name)
 
 
 class SPMDModelRuntime(ModelRuntime):
@@ -125,57 +379,72 @@ class SPMDModelRuntime(ModelRuntime):
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)
         self._spmd = jax.process_count() > 1
-        # Ordinal agreed with workers via the shared --models ordering;
-        # carried in the opcode header so multi-model pods stay in step.
+        # Ordinals agreed with workers via the shared --models ordering
+        # (and replica position within a ReplicaSet); carried in the opcode
+        # header so multi-model / dp pods stay in step.
         self.spmd_index = 0
+        self.spmd_replica = 0
+        self._cadence = _OpCadence()
+
+    def _mirrored(self, op, a, b, values, dispatch):
+        return _mirrored_dispatch(self, op, a, b, values, dispatch)
 
     def _dispatch_prefill(self, bucket, B, tokens, lens, slot_ids, pt_rows,
                           temp, tk, tp, pen, pres, freq, seeds, key):
-        if self._spmd:
-            _send(OP_PREFILL, bucket, B, self.spmd_index,
-                  (tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen,
-                   pres, freq, seeds, key),
-                  self.ecfg.max_slots, self.ecfg.max_pages_per_seq)
-        return super()._dispatch_prefill(
-            bucket, B, tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen,
-            pres, freq, seeds, key
-        )
+        if not self._spmd:
+            return super()._dispatch_prefill(
+                bucket, B, tokens, lens, slot_ids, pt_rows, temp, tk, tp,
+                pen, pres, freq, seeds, key)
+        return self._mirrored(
+            OP_PREFILL, bucket, B,
+            (tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen, pres,
+             freq, seeds, key),
+            lambda: super(SPMDModelRuntime, self)._dispatch_prefill(
+                bucket, B, tokens, lens, slot_ids, pt_rows, temp, tk, tp,
+                pen, pres, freq, seeds, key))
 
     def _dispatch_chunk(self, chunk, tokens, start, cl, slot_id, is_final,
                         pt_row, temp, tk, tp, pen, pres, freq, seeds, key):
-        if self._spmd:
-            _send(OP_CHUNK, chunk, 0, self.spmd_index,
-                  (tokens, start, cl, slot_id, is_final, pt_row, temp, tk,
-                   tp, pen, pres, freq, seeds, key),
-                  self.ecfg.max_slots, self.ecfg.max_pages_per_seq)
-        return super()._dispatch_chunk(
-            chunk, tokens, start, cl, slot_id, is_final, pt_row, temp, tk,
-            tp, pen, pres, freq, seeds, key
-        )
+        if not self._spmd:
+            return super()._dispatch_chunk(
+                chunk, tokens, start, cl, slot_id, is_final, pt_row, temp,
+                tk, tp, pen, pres, freq, seeds, key)
+        return self._mirrored(
+            OP_CHUNK, chunk, 0,
+            (tokens, start, cl, slot_id, is_final, pt_row, temp, tk, tp,
+             pen, pres, freq, seeds, key),
+            lambda: super(SPMDModelRuntime, self)._dispatch_chunk(
+                chunk, tokens, start, cl, slot_id, is_final, pt_row, temp,
+                tk, tp, pen, pres, freq, seeds, key))
 
     def _dispatch_decode(self, k_steps, tokens, positions, active, pt, temp,
                          tk, tp, pen, pres, freq, seeds, key):
-        if self._spmd:
-            _send(OP_DECODE, k_steps, 0, self.spmd_index,
-                  (tokens, positions, active, pt, temp, tk, tp, pen, pres,
-                   freq, seeds, key),
-                  self.ecfg.max_slots, self.ecfg.max_pages_per_seq)
-        return super()._dispatch_decode(
-            k_steps, tokens, positions, active, pt, temp, tk, tp, pen,
-            pres, freq, seeds, key
-        )
+        if not self._spmd:
+            return super()._dispatch_decode(
+                k_steps, tokens, positions, active, pt, temp, tk, tp, pen,
+                pres, freq, seeds, key)
+        return self._mirrored(
+            OP_DECODE, k_steps, 0,
+            (tokens, positions, active, pt, temp, tk, tp, pen, pres, freq,
+             seeds, key),
+            lambda: super(SPMDModelRuntime, self)._dispatch_decode(
+                k_steps, tokens, positions, active, pt, temp, tk, tp, pen,
+                pres, freq, seeds, key))
 
     def _dispatch_prefill_sp(self, T, tokens, lens, slot_ids, pt_rows,
                              temp, tk, tp, pen, pres, freq, seeds, key):
-        if self._spmd:
-            _send(OP_PREFILL_SP, T, 0, self.spmd_index,
-                  (tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen,
-                   pres, freq, seeds, key),
-                  self.ecfg.max_slots, self.ecfg.max_pages_per_seq)
-        return super()._dispatch_prefill_sp(
-            T, tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen, pres,
-            freq, seeds, key
-        )
+        if not self._spmd:
+            return super()._dispatch_prefill_sp(
+                T, tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen,
+                pres, freq, seeds, key)
+        return self._mirrored(
+            OP_PREFILL_SP, T, 0,
+            (tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen, pres,
+             freq, seeds, key),
+            lambda: super(SPMDModelRuntime, self)._dispatch_prefill_sp(
+                T, tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen,
+                pres, freq, seeds, key))
+
 
 class SPMDEncoderRuntime(EncoderRuntime):
     """EncoderRuntime whose batch-encode dispatches are mirrored on every
@@ -185,48 +454,205 @@ class SPMDEncoderRuntime(EncoderRuntime):
         super().__init__(*args, **kw)
         self._spmd = jax.process_count() > 1
         self.spmd_index = 0
+        self.spmd_replica = 0
+        self._cadence = _OpCadence()
 
     def _dispatch_encode(self, B, bucket, tokens, lens):
-        if self._spmd:
-            _send(OP_ENCODE, B, bucket, self.spmd_index, (tokens, lens),
-                  self.ecfg.max_slots, self.ecfg.max_pages_per_seq)
-        return super()._dispatch_encode(B, bucket, tokens, lens)
+        if not self._spmd:
+            return super()._dispatch_encode(B, bucket, tokens, lens)
+        return _mirrored_dispatch(
+            self, OP_ENCODE, B, bucket, (tokens, lens),
+            lambda: super(SPMDEncoderRuntime, self)._dispatch_encode(
+                B, bucket, tokens, lens))
+
+
+def _build_runtimes(name, ckpt, engine_cfg, mesh, dtype):
+    """Worker-side replica list for one model: the SAME shared construction
+    path the primary's load_model uses (engine.build_model_runtimes), with
+    the SPMD runtime classes — every host must build byte-identical
+    computations."""
+    from ollamamq_tpu.config import get_model_config
+    from ollamamq_tpu.engine.engine import build_model_runtimes
+
+    cfg = get_model_config(name)
+    if cfg is None:
+        raise ValueError(f"model {name} not replayable under SPMD")
+    return build_model_runtimes(name, cfg, engine_cfg, mesh, dtype, ckpt,
+                                SPMDModelRuntime, SPMDEncoderRuntime)
 
 
 class SPMDEngine:
     """Factory + lifecycle glue for the primary host: a TPUEngine whose
-    generative runtimes broadcast their dispatches, rejecting what the
-    worker protocol can't replay yet, and releasing workers on stop."""
+    runtimes broadcast their dispatches, whose model load/evict/reload
+    control operations broadcast as opcodes serialized on the engine
+    thread, and which releases workers on stop."""
 
     def __new__(cls, *args, **kw):
-        from ollamamq_tpu.engine.engine import TPUEngine
+        from ollamamq_tpu.engine.engine import ReplicaSet, TPUEngine
 
         class _Engine(TPUEngine):
             runtime_class = SPMDModelRuntime
             encoder_runtime_class = SPMDEncoderRuntime
 
+            def _renumber(self):
+                """Re-derive (model ordinal, replica ordinal) for every
+                runtime from the dict order — the same order the worker
+                maintains its mirrored list in."""
+                for mi, rt in enumerate(self.runtimes.values()):
+                    reps = rt.replicas if isinstance(rt, ReplicaSet) else [rt]
+                    for ri, rep in enumerate(reps):
+                        rep.spmd_index = mi
+                        rep.spmd_replica = ri
+
             def load_model(self, name, checkpoint_path=None):
-                if self.ecfg.dp > 1:
-                    raise NotImplementedError(
-                        "dp replica serving under --spmd is not supported "
-                        "yet (the worker replay protocol carries no replica "
-                        "ordinal); use dp on single-host deployments"
-                    )
+                if name in self.runtimes:
+                    return
                 if self._running and jax.process_count() > 1:
-                    raise NotImplementedError(
-                        "runtime model load (/api/pull) is not supported "
-                        "under --spmd; list all models at startup"
-                    )
+                    from ollamamq_tpu.config import get_model_config
+
+                    if get_model_config(name) is None:
+                        # Validate BEFORE broadcasting: a post-broadcast
+                        # failure would leave worker ordinal lists with an
+                        # entry the primary never added.
+                        raise KeyError(f"unknown model architecture: {name}")
+
+                    # Runtime /api/pull: broadcast OP_LOAD from the engine
+                    # thread (ordered with dispatches), load on every host.
+                    def _do():
+                        if name in self.runtimes:
+                            # A concurrent pull of the same model won the
+                            # race; broadcasting a second OP_LOAD would
+                            # desync worker ordinals permanently.
+                            return
+                        n_reps = (self.ecfg.dp
+                                  if not _is_encoder_name(name) else 1)
+                        _send(OP_LOAD, n_reps, 0, len(self.runtimes), 0,
+                              (_encode_str(name, NAME_LEN),
+                               _encode_str(checkpoint_path, PATH_LEN)),
+                              self.ecfg.max_slots,
+                              self.ecfg.max_pages_per_seq)
+                        ok = False
+                        try:
+                            super(_Engine, self).load_model(
+                                name, checkpoint_path)
+                            self._renumber()
+                            ok = True
+                        finally:
+                            flags = _bus.sync(ok)
+                            if ok and flags.any():
+                                # Worker holds a None placeholder at this
+                                # ordinal; first dispatch will fail loudly
+                                # and the reload path rebuilds it.
+                                raise RuntimeError(
+                                    f"worker host(s) "
+                                    f"{np.nonzero(flags)[0].tolist()} "
+                                    f"failed loading {name}; serving "
+                                    "deferred to reload recovery")
+
+                    return self.call_on_loop(_do)
                 super().load_model(name, checkpoint_path)
-                rt = self.runtimes.get(name)
-                if isinstance(rt, (SPMDModelRuntime, SPMDEncoderRuntime)):
-                    rt.spmd_index = list(self.runtimes).index(name)
+                self._renumber()
+
+            def evict_model(self, name):
+                if (name in self.runtimes and self._running
+                        and jax.process_count() > 1):
+                    def _do():
+                        rt = self.runtimes.get(name)
+                        if rt is None:
+                            return False
+                        if rt.has_work():
+                            # Validate BEFORE broadcasting so the worker
+                            # never evicts what the primary kept.
+                            raise RuntimeError(
+                                f"model {name} has in-flight work")
+                        mi = list(self.runtimes).index(name)
+                        _send(OP_EVICT, 0, 0, mi, 0,
+                              (_encode_str(name, NAME_LEN),),
+                              self.ecfg.max_slots,
+                              self.ecfg.max_pages_per_seq)
+                        ok = False
+                        try:
+                            out = super(_Engine, self).evict_model(name)
+                            self._renumber()
+                            ok = True
+                            return out
+                        finally:
+                            flags = _bus.sync(ok)
+                            if ok and flags.any():
+                                log.error(
+                                    "worker host(s) %s failed evicting %s "
+                                    "— ordinal desync; reload will follow",
+                                    np.nonzero(flags)[0].tolist(), name)
+
+                    return self.call_on_loop(_do)
+                out = super().evict_model(name)
+                self._renumber()
+                return out
+
+            def _start_rebuild(self, rt):
+                if jax.process_count() <= 1:
+                    return super()._start_rebuild(rt)
+                # Engine thread (via _try_recover ← _loop): broadcast the
+                # reload and rebuild INLINE so the weight reload + KV alloc
+                # happen at the same point of the op stream on every host.
+                # Serving pauses for the reload; that is the cost of
+                # lock-step recovery, and it is loud in the logs.
+                log.warning("SPMD reload of %s (model %d replica %d) on "
+                            "all hosts", rt.name, rt.spmd_index,
+                            rt.spmd_replica)
+                _send(OP_RELOAD, 0, 0, rt.spmd_index, rt.spmd_replica, (),
+                      self.ecfg.max_slots, self.ecfg.max_pages_per_seq)
+                ok = False
+                try:
+                    self._rebuild_runtime(rt)  # posts to _rebuilt on success
+                    ok = True
+                finally:
+                    flags = _bus.sync(ok)
+                    if ok and flags.any():
+                        log.error(
+                            "worker host(s) %s failed the reload of %s; "
+                            "next dispatch will fail it again and retry",
+                            np.nonzero(flags)[0].tolist(), rt.name)
+                self._swap_rebuilt()
 
             def stop(self):
                 super().stop()
                 broadcast_shutdown()  # exactly once, after dispatches ended
 
-        return _Engine(*args, **kw)
+        eng = _Engine(*args, **kw)
+        eng._renumber()
+        return eng
+
+
+def _is_encoder_name(name: str) -> bool:
+    from ollamamq_tpu.config import get_model_config
+
+    cfg = get_model_config(name)
+    return bool(cfg is not None and cfg.is_encoder)
+
+
+class _DeadReplica:
+    """Placeholder for an ordinal slot whose runtime failed to build: keeps
+    the slot's status-sync cadence alive (the primary's runtime still
+    dispatches and syncs on ITS cadence until the reload lands) and makes
+    any routed replay fail loudly."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._cadence = _OpCadence()
+
+
+def _slot(replica_lists, specs, mi, ri):
+    """The holder at (mi, ri), growing the mirrored structure with dead
+    replicas when the primary references an ordinal we never built (a
+    protocol bug — kept loud but sync-aligned)."""
+    while len(replica_lists) <= mi:
+        replica_lists.append([])
+        specs.append(("?", None))
+    row = replica_lists[mi]
+    while len(row) <= ri:
+        row.append(_DeadReplica(specs[mi][0]))
+    return row[ri]
 
 
 def run_worker(
@@ -239,80 +665,168 @@ def run_worker(
     """Worker-host loop (process_id != 0): replay the primary's dispatches.
 
     `models`: {name: checkpoint_path_or_None} in the SAME order as the
-    primary's --models list — the opcode header routes by that ordinal.
-    Returns the number of steps executed. `max_steps` bounds the loop for
-    tests; production workers run until OP_SHUTDOWN.
+    primary's --models list — the opcode header routes by that ordinal
+    (and by replica ordinal within a dp ReplicaSet). Returns the number of
+    ops replayed. `max_steps` bounds the loop for tests; production
+    workers run until OP_SHUTDOWN.
+
+    A replay failure is answered over the KV-store status sync: the
+    primary fails that runtime loudly and sends OP_RELOAD, which rebuilds
+    the replica here from pristine config — no silently-diverged serving.
     """
     from ollamamq_tpu.config import get_model_config
 
-    runtimes = []
+    replica_lists = []  # [model ordinal] -> [replica ordinal] -> runtime|None
+    specs = []  # [model ordinal] -> (name, ckpt)
     for name, ckpt in models.items():
-        cfg = get_model_config(name)
-        if cfg is None:
-            raise ValueError(f"model {name} not replayable under SPMD")
-        cls = SPMDEncoderRuntime if cfg.is_encoder else SPMDModelRuntime
-        runtimes.append(
-            cls(name, cfg, engine_cfg, mesh=mesh,
-                checkpoint_path=ckpt, dtype=dtype)
-        )
+        replica_lists.append(_build_runtimes(name, ckpt, engine_cfg, mesh, dtype))
+        specs.append((name, ckpt))
     steps = 0
     S = engine_cfg.max_slots
     MP = engine_cfg.max_pages_per_seq
+    DATA_OPS = (OP_PREFILL, OP_CHUNK, OP_DECODE, OP_PREFILL_SP, OP_ENCODE)
 
+    wire_seq = 0
     while max_steps is None or steps < max_steps:
-        header = _bcast(np.zeros(4, np.int32))
-        op = int(header[0])
+        header, raw = _recv_op(wire_seq)
+        wire_seq += 1
+        op, a, b, mi, ri = (int(x) for x in header)
         if op == OP_SHUTDOWN:
             break
-        rt = runtimes[int(header[3])] if int(header[3]) < len(runtimes) else runtimes[0]
+        ok = True
         try:
-            if op == OP_PREFILL:
-                bucket, B = int(header[1]), int(header[2])
-                (tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen, pres,
-                 freq, seeds, key_data) = _recv(op, bucket, B, S, MP)
-                key = jnp.asarray(key_data, jnp.uint32)
-                _, rt.kc, rt.vc, rt.recent = ModelRuntime._dispatch_prefill(
-                    rt, bucket, B, tokens, lens, slot_ids, pt_rows, temp,
-                    tk, tp, pen, pres, freq, seeds, key
-                )
-            elif op == OP_CHUNK:
-                chunk = int(header[1])
-                (tokens, start, cl, slot_id, is_final, pt_row, temp, tk, tp,
-                 pen, pres, freq, seeds, key_data) = _recv(op, chunk, 0, S, MP)
-                key = jnp.asarray(key_data, jnp.uint32)
-                _, rt.kc, rt.vc, rt.recent = ModelRuntime._dispatch_chunk(
-                    rt, chunk, tokens, start, cl, slot_id, is_final, pt_row,
-                    temp, tk, tp, pen, pres, freq, seeds, key
-                )
-            elif op == OP_DECODE:
-                k_steps = int(header[1])
-                (tokens, positions, active, pt, temp, tk, tp, pen, pres,
-                 freq, seeds, key_data) = _recv(op, k_steps, 0, S, MP)
-                key = jnp.asarray(key_data, jnp.uint32)
-                _, rt.kc, rt.vc, rt.recent = ModelRuntime._dispatch_decode(
-                    rt, k_steps, tokens, positions, active, pt, temp, tk,
-                    tp, pen, pres, freq, seeds, key
-                )
-            elif op == OP_PREFILL_SP:
-                T = int(header[1])
-                (tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen, pres,
-                 freq, seeds, key_data) = _recv(op, T, 0, S, MP)
-                key = jnp.asarray(key_data, jnp.uint32)
-                _, rt.kc, rt.vc, rt.recent = ModelRuntime._dispatch_prefill_sp(
-                    rt, T, tokens, lens, slot_ids, pt_rows, temp, tk, tp,
-                    pen, pres, freq, seeds, key
-                )
-            elif op == OP_ENCODE:
-                B, bucket = int(header[1]), int(header[2])
-                tokens, lens = _recv(op, B, bucket, S, MP)
-                EncoderRuntime._dispatch_encode(rt, B, bucket, tokens, lens)
+            payload = _unpack_payload(raw, payload_spec(op, a, b, S, MP))
+            if op in DATA_OPS:
+                rt = _slot(replica_lists, specs, mi, ri)
+                if isinstance(rt, _DeadReplica):
+                    raise RuntimeError(
+                        f"no live runtime at ordinal ({mi},{ri}) for op {op}")
+                outs = _replay(rt, op, a, b, payload)
+                if _serialize_multihost():
+                    # Block on EVERY output (incl. the discarded sampled
+                    # tokens): a trailing collective still in flight when
+                    # the next broadcast-receive hits the shared gloo
+                    # context would interleave and abort the pair.
+                    jax.block_until_ready(outs)
+            elif op == OP_RELOAD:
+                name, ckpt = specs[mi]
+                cfg = get_model_config(name)
+                old = _slot(replica_lists, specs, mi, ri)
+                sub_mesh = (old.mesh if not isinstance(old, _DeadReplica)
+                            else _replica_mesh(mesh, engine_cfg, cfg, ri))
+                cls = (SPMDEncoderRuntime if cfg.is_encoder
+                       else SPMDModelRuntime)
+                # Free old HBM before the reload; the dead placeholder holds
+                # the slot (and a fresh cadence, mirroring the primary's
+                # fresh runtime) if the rebuild below raises.
+                replica_lists[mi][ri] = _DeadReplica(name)
+                del old
+                replica_lists[mi][ri] = cls(
+                    name, cfg, engine_cfg, mesh=sub_mesh,
+                    checkpoint_path=ckpt, dtype=dtype)
+                log.warning("worker reloaded %s (model %d replica %d)",
+                            name, mi, ri)
+            elif op == OP_LOAD:
+                name = _decode_str(payload[0])
+                ckpt = _decode_str(payload[1]) or None
+                specs.append((name, ckpt))
+                try:
+                    replica_lists.append(
+                        _build_runtimes(name, ckpt, engine_cfg, mesh, dtype))
+                except Exception:
+                    # Keep ordinals aligned; OP_RELOAD rebuilds the holes.
+                    replica_lists.append(
+                        [_DeadReplica(name) for _ in range(max(1, a))])
+                    raise
+            elif op == OP_EVICT:
+                name = _decode_str(payload[0])
+                if specs[mi][0] != name:
+                    raise RuntimeError(
+                        f"evict ordinal {mi} names {specs[mi][0]}, "
+                        f"primary said {name}")
+                del replica_lists[mi]
+                del specs[mi]
             else:
                 log.error("unknown opcode %d; shutting down", op)
                 break
         except Exception:
-            # The primary recovers from a failed step (errors the batch and
-            # keeps serving); the worker must stay in lock-step with it
-            # rather than die and deadlock the next broadcast.
-            log.exception("worker step failed (op=%d); continuing", op)
+            ok = False
+            log.exception("worker op failed (op=%d mi=%d ri=%d); reporting "
+                          "desync", op, mi, ri)
+        # Status sync: data ops ride the TARGET RUNTIME's cadence (matching
+        # the primary's per-runtime cadence); control ops always sync (the
+        # primary waits on the result).
+        if op in DATA_OPS:
+            _slot(replica_lists, specs, mi, ri)._cadence.after_op(ok)
+        else:
+            flags = _bus.sync(ok)
+            if op == OP_LOAD and flags[0]:
+                # Primary's own load failed AFTER broadcasting: it never
+                # added the model, so drop our entry to realign ordinals.
+                replica_lists.pop()
+                specs.pop()
         steps += 1
     return steps
+
+
+def _replica_mesh(mesh, engine_cfg, cfg, ri):
+    from jax.sharding import Mesh
+
+    if cfg.is_encoder or engine_cfg.dp <= 1 or mesh is None:
+        return mesh
+    return Mesh(mesh.devices[ri:ri + 1], mesh.axis_names)
+
+
+def _serialize_multihost() -> bool:
+    # Mirror of TPUEngine._serialize_multihost: CPU-gloo collectives from
+    # two concurrently-executing computations interleave differently per
+    # process and abort; force one cross-host computation at a time.
+    return jax.process_count() > 1 and jax.default_backend() == "cpu"
+
+
+def _replay(rt, op, a, b, payload):
+    """Execute one data op against a worker replica, mirroring the
+    primary's dispatch exactly (same jit, same inputs). Returns every
+    device output of the replayed computation."""
+    if op == OP_PREFILL:
+        bucket, B = a, b
+        (tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen, pres,
+         freq, seeds, key_data) = payload
+        key = jnp.asarray(key_data, jnp.uint32)
+        toks, rt.kc, rt.vc, rt.recent = ModelRuntime._dispatch_prefill(
+            rt, bucket, B, tokens, lens, slot_ids, pt_rows, temp,
+            tk, tp, pen, pres, freq, seeds, key)
+        return (toks, rt.kc, rt.vc, rt.recent)
+    elif op == OP_CHUNK:
+        chunk = a
+        (tokens, start, cl, slot_id, is_final, pt_row, temp, tk, tp,
+         pen, pres, freq, seeds, key_data) = payload
+        key = jnp.asarray(key_data, jnp.uint32)
+        toks, rt.kc, rt.vc, rt.recent = ModelRuntime._dispatch_chunk(
+            rt, chunk, tokens, start, cl, slot_id, is_final, pt_row,
+            temp, tk, tp, pen, pres, freq, seeds, key)
+        return (toks, rt.kc, rt.vc, rt.recent)
+    elif op == OP_DECODE:
+        k_steps = a
+        (tokens, positions, active, pt, temp, tk, tp, pen, pres,
+         freq, seeds, key_data) = payload
+        key = jnp.asarray(key_data, jnp.uint32)
+        toks, rt.kc, rt.vc, rt.recent = ModelRuntime._dispatch_decode(
+            rt, k_steps, tokens, positions, active, pt, temp, tk,
+            tp, pen, pres, freq, seeds, key)
+        return (toks, rt.kc, rt.vc, rt.recent)
+    elif op == OP_PREFILL_SP:
+        T = a
+        (tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen, pres,
+         freq, seeds, key_data) = payload
+        key = jnp.asarray(key_data, jnp.uint32)
+        toks, rt.kc, rt.vc, rt.recent = ModelRuntime._dispatch_prefill_sp(
+            rt, T, tokens, lens, slot_ids, pt_rows, temp, tk, tp,
+            pen, pres, freq, seeds, key)
+        return (toks, rt.kc, rt.vc, rt.recent)
+    elif op == OP_ENCODE:
+        B, bucket = a, b
+        tokens, lens = payload
+        return EncoderRuntime._dispatch_encode(rt, B, bucket, tokens, lens)
+    else:  # pragma: no cover — guarded by the caller's DATA_OPS check
+        raise ValueError(f"not a data op: {op}")

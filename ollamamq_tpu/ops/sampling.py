@@ -27,7 +27,9 @@ class SamplingParams:
     repeat_penalty: float = 1.0  # 1.0 => off (Ollama's default is 1.1)
     presence_penalty: float = 0.0  # additive, OpenAI semantics (0 => off)
     frequency_penalty: float = 0.0  # additive per occurrence (0 => off)
-    seed: int = 0  # >0 => per-request reproducible sampling stream
+    # None => unseeded. Any provided integer — INCLUDING 0, which OpenAI
+    # clients pass expecting reproducibility — maps to a seeded stream.
+    seed: "int | None" = None  # stored as int32 > 0 after __post_init__
     max_tokens: int = 256
     stop: tuple = ()
 
@@ -36,9 +38,10 @@ class SamplingParams:
         # OverflowError in the engine thread (numpy 2 rejects lossy int32
         # assignment) and fail every in-flight request on the runtime. Fold
         # arbitrary client seeds (OpenAI seeds are commonly 64-bit) into
-        # [1, 2^31-1] deterministically; only a literal 0 stays unseeded.
-        s = int(self.seed)
-        self.seed = (s % 0x7FFFFFFE) + 1 if s else 0
+        # [1, 2^31-1] deterministically; seed=0 is a VALID seed (folds to
+        # 1), distinct from absent (None -> 0 = engine-stream sampling).
+        self.seed = 0 if self.seed is None else (
+            int(self.seed) % 0x7FFFFFFE) + 1
 
     @classmethod
     def from_ollama_options(cls, options: dict, max_tokens_default: int) -> "SamplingParams":
@@ -50,7 +53,7 @@ class SamplingParams:
             repeat_penalty=float(options.get("repeat_penalty", 1.1) or 1.0),
             presence_penalty=float(options.get("presence_penalty", 0.0) or 0.0),
             frequency_penalty=float(options.get("frequency_penalty", 0.0) or 0.0),
-            seed=int(options.get("seed", 0) or 0),
+            seed=options.get("seed"),  # absent/null => None => unseeded
             max_tokens=int(options.get("num_predict", max_tokens_default) or max_tokens_default),
             stop=tuple(options.get("stop", []) or []),
         )
@@ -69,7 +72,7 @@ class SamplingParams:
             repeat_penalty=float(body.get("repeat_penalty", 1.0) or 1.0),
             presence_penalty=float(body.get("presence_penalty", 0.0) or 0.0),
             frequency_penalty=float(body.get("frequency_penalty", 0.0) or 0.0),
-            seed=int(body.get("seed", 0) or 0),
+            seed=body.get("seed"),  # absent/null => None => unseeded
             max_tokens=int(
                 body.get("max_tokens") or body.get("max_completion_tokens") or max_tokens_default
             ),

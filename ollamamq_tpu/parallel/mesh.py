@@ -47,7 +47,17 @@ def make_mesh(
     k = dp * sp * tp
     if k > n:
         raise ValueError(f"dp*sp*tp={k} > {n} available devices")
-    arr = np.asarray(devices[:k]).reshape(dp, sp, tp)
+    if dp > 1 and jax.process_count() > 1:
+        # Multi-host dp replica serving slices the mesh along the data axis
+        # (one submesh per replica). jax.devices() is process-major, so the
+        # default dp-outermost layout would give each replica the chips of
+        # ONE host — a submesh the other processes can't participate in
+        # (multi-controller jit requires every process to own addressable
+        # shards). Arrange dp along the fastest-varying (intra-host) device
+        # index instead so every dp slice spans every process.
+        arr = np.asarray(devices[:k]).reshape(sp, tp, dp).transpose(2, 0, 1)
+    else:
+        arr = np.asarray(devices[:k]).reshape(dp, sp, tp)
     return Mesh(arr, (AXIS_DATA, AXIS_SEQ, AXIS_TENSOR))
 
 

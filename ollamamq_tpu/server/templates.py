@@ -13,16 +13,26 @@ from typing import List, Optional
 from ollamamq_tpu.config import ModelConfig
 
 
+def chat_family(cfg: Optional[ModelConfig]) -> str:
+    """'chatml' | 'llama3' | 'plain' — the ONE place the template-family
+    heuristics live. render_chat and template_owns_bos both read this, so
+    the dispatch can't silently drift between them (a divergence doubles
+    or drops the BOS on every chat prompt)."""
+    if cfg is None:
+        return "plain"
+    if cfg.attn_bias:  # Qwen2 family marker
+        return "chatml"
+    if not cfg.is_encoder and cfg.vocab_size > 100_000:
+        return "llama3"
+    return "plain"
+
+
 def template_owns_bos(cfg: Optional[ModelConfig]) -> bool:
     """True when the chat template emits its own begin-of-sequence text
     (Llama-3's <|begin_of_text|>) or the format defines none (ChatML).
     Plain-fallback models still need the tokenizer's BOS prepended —
     callers pass add_bos=not template_owns_bos(cfg) to encode()."""
-    if cfg is None:
-        return False
-    if cfg.attn_bias:  # ChatML: no BOS concept
-        return True
-    return not cfg.is_encoder and cfg.vocab_size > 100_000  # Llama-3 header
+    return chat_family(cfg) in ("chatml", "llama3")
 
 
 def render_chat(messages: List[dict], cfg: Optional[ModelConfig]) -> str:
@@ -37,15 +47,15 @@ def render_chat(messages: List[dict], cfg: Optional[ModelConfig]) -> str:
             )
         msgs.append((role, content))
 
-    if cfg is not None and cfg.attn_bias:  # Qwen2 family: ChatML
+    family = chat_family(cfg)
+    if family == "chatml":
         out = []
         for role, content in msgs:
             out.append(f"<|im_start|>{role}\n{content}<|im_end|>\n")
         out.append("<|im_start|>assistant\n")
         return "".join(out)
 
-    if cfg is not None and not cfg.is_encoder and cfg.vocab_size > 100_000:
-        # Llama 3 family header format.
+    if family == "llama3":
         out = ["<|begin_of_text|>"]
         for role, content in msgs:
             out.append(
@@ -54,7 +64,6 @@ def render_chat(messages: List[dict], cfg: Optional[ModelConfig]) -> str:
         out.append("<|start_header_id|>assistant<|end_header_id|>\n\n")
         return "".join(out)
 
-    # Plain fallback (test models / byte tokenizer).
     out = []
     for role, content in msgs:
         out.append(f"{role}: {content}\n")

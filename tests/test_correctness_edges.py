@@ -1,0 +1,160 @@
+"""Round-3 correctness edges (VERDICT r2 "what's weak" #5-#7 + ADVICE):
+
+- resolve_runtime kind filter: generative requests never land on an
+  EncoderRuntime via the empty-model fallback (they would "finish" with
+  an embedding and zero tokens).
+- ReplicaSet.submit returns work to the queue instead of parking on a
+  full replica (wait-in-queue semantics, dispatcher.rs:467-473).
+- EncoderRuntime compiles a B=1 variant so a lone embedding request
+  doesn't pay the 8x padded batch.
+- seed=0 is a VALID seed (OpenAI clients pass it expecting determinism),
+  distinct from seed-absent.
+"""
+
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from ollamamq_tpu.config import EngineConfig
+from ollamamq_tpu.engine.engine import ReplicaSet, TPUEngine
+from ollamamq_tpu.ops.sampling import SamplingParams
+
+
+@pytest.fixture(scope="module")
+def encoder_only_engine():
+    eng = TPUEngine(
+        EngineConfig(model="test-tiny-embed", max_slots=2, num_pages=32,
+                     page_size=8, max_pages_per_seq=8,
+                     prefill_buckets=(16,), decode_steps_per_iter=2),
+        models={"test-tiny-embed": None},
+        blocklist_path=None, dtype=jnp.float32,
+    )
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def _wait(req, budget=60):
+    deadline = time.monotonic() + budget
+    while time.monotonic() < deadline:
+        item = req.stream.get(timeout=0.5)
+        if item and item.kind in ("done", "error"):
+            return item
+    return None
+
+
+def test_generative_request_never_lands_on_encoder(encoder_only_engine):
+    eng = encoder_only_engine
+    # Empty model name, generate kind: the fallback must NOT pick the
+    # encoder — with no generative runtime loaded the request errors.
+    req = eng.enqueue_request("edgeA", "", "", prompt_tokens=[1, 2, 3],
+                              sampling=SamplingParams(max_tokens=4))
+    item = _wait(req)
+    assert item is not None and item.kind == "error"
+    assert "model not loaded" in (item.error or "")
+    assert req.generated_ids == [] and req.embedding is None
+
+
+def test_embed_request_resolves_encoder_via_fallback(encoder_only_engine):
+    eng = encoder_only_engine
+    tok = eng.runtimes["test-tiny-embed"].tokenizer
+    req = eng.enqueue_request("edgeB", "", "", kind="embed",
+                              prompt_tokens=tok.encode("hello"),
+                              sampling=SamplingParams())
+    item = _wait(req)
+    assert item is not None and item.kind == "done"
+    assert req.embedding and len(req.embedding) > 0
+
+
+def test_encoder_compiles_b1_for_single_request(encoder_only_engine):
+    eng = encoder_only_engine
+    rt = eng.runtimes["test-tiny-embed"]
+    tok = rt.tokenizer
+    req = eng.enqueue_request("edgeC", "", "test-tiny-embed", kind="embed",
+                              prompt_tokens=tok.encode("one"),
+                              sampling=SamplingParams())
+    assert _wait(req).kind == "done"
+    assert any(batch == 1 for batch, _bucket in rt._jits), rt._jits.keys()
+    assert not any(batch == 8 for batch, _bucket in rt._jits)
+
+
+class _StubReplica:
+    def __init__(self, capacity, load, failed=False):
+        self.name = "stub"
+        self.cfg = None
+        self.ecfg = None
+        self._capacity = capacity
+        self._load_n = load
+        self._failed = failed
+        self.pending_prefill = []
+        self.chunking = []
+        self.submitted = []
+
+    def has_capacity(self):
+        return self._capacity
+
+    def active_count(self):
+        return self._load_n
+
+    def submit(self, req):
+        self.submitted.append(req)
+        return True
+
+
+def test_replicaset_submit_refuses_when_full():
+    rs = ReplicaSet([_StubReplica(False, 1), _StubReplica(False, 0)])
+    assert rs.submit(object()) is False
+    assert all(not r.submitted for r in rs.replicas)
+
+
+def test_replicaset_force_submit_picks_least_loaded_live():
+    a, b, c = (_StubReplica(False, 3), _StubReplica(False, 1, failed=True),
+               _StubReplica(False, 2))
+    rs = ReplicaSet([a, b, c])
+    rs.force_submit(object())
+    # b is failed; c is the least-loaded live replica.
+    assert c.submitted and not a.submitted and not b.submitted
+
+
+def test_place_requeues_when_replica_capacity_races_away():
+    eng = TPUEngine(
+        EngineConfig(model="test-tiny", max_slots=2, num_pages=32,
+                     page_size=8, max_pages_per_seq=8,
+                     prefill_buckets=(16,), decode_steps_per_iter=2),
+        models={"test-tiny": None},
+        blocklist_path=None, dtype=jnp.float32,
+    )
+    # No engine loop: drive _place directly with a runtime that refuses.
+    rt = eng.runtimes["test-tiny"]
+    orig_submit = rt.submit
+    rt.submit = lambda req: False
+    try:
+        req = eng.enqueue_request("edgeD", "", "test-tiny",
+                                  prompt_tokens=[1, 2],
+                                  sampling=SamplingParams(max_tokens=2))
+        popped = eng.core.next(eligible_models=["test-tiny"])
+        assert popped is not None and popped[0] == req.req_id
+        placed = eng._place(req, "edgeD", "test-tiny")
+        assert placed is False
+        # Back in the native queue under a fresh id, still registered.
+        snap = eng.core.snapshot()
+        assert snap["users"]["edgeD"]["queued"] == 1
+        assert req.req_id in eng.pending
+        assert req.req_id != popped[0]
+    finally:
+        rt.submit = orig_submit
+
+
+def test_seed_zero_is_reproducible_and_distinct_from_absent():
+    assert SamplingParams().seed == 0  # absent => engine stream
+    assert SamplingParams(seed=None).seed == 0
+    s0 = SamplingParams(seed=0)
+    assert s0.seed > 0  # explicit 0 => a real, deterministic seed
+    assert SamplingParams(seed=0).seed == s0.seed
+    assert SamplingParams(seed=0).seed != SamplingParams(seed=1).seed
+    # Ollama / OpenAI parsers preserve the distinction.
+    assert SamplingParams.from_ollama_options({"seed": 0}, 16).seed == s0.seed
+    assert SamplingParams.from_ollama_options({}, 16).seed == 0
+    assert SamplingParams.from_openai({"seed": 0}, 16).seed == s0.seed
+    assert SamplingParams.from_openai({}, 16).seed == 0

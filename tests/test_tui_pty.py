@@ -1,0 +1,183 @@
+"""Drive the native C++ admin TUI (cpp/tui.cpp) through a real pty.
+
+PARITY: the reference TUI's admin verbs (tui.rs:153-216) — VIP star on
+the selected user, block persisting to blocked_items.json, unblock, and
+clean quit — exercised against the actual rendered frames and the actual
+key loop, not the snapshot functions in isolation.
+
+Harness notes: the TUI writes full frames at the refresh cadence; a
+stalled reader fills the pty buffer and blocks the frame write, wedging
+the key loop — so a drain thread consumes the master side for the whole
+run.
+"""
+
+import fcntl
+import json
+import os
+import pty
+import struct
+import subprocess
+import sys
+import termios
+import threading
+import time
+
+import pytest
+
+_CHILD = r"""
+import sys
+from ollamamq_tpu.core.mqcore import MQCore
+from ollamamq_tpu.admin import tui as admin_tui
+
+# The stats callback's HBM refresh imports jax; with a wedged remote TPU
+# tunnel that import can hang the first frame indefinitely. The TUI test
+# is about the key loop and persistence, not devices — pin the cache so
+# the jax branch never runs.
+admin_tui._hbm_cache.update(
+    ts=float("inf"), used=0, total=0, device="test-device"
+)
+
+core = MQCore(sys.argv[1])
+core.enqueue("alice", "10.0.0.1")
+core.enqueue("bob", "10.0.0.2")
+
+
+class Eng:
+    pass
+
+
+eng = Eng()
+eng.core = core
+eng.runtimes = {}
+admin_tui.run_tui(eng, None, refresh_ms=50)
+print("TUI_EXIT_OK", flush=True)
+"""
+
+
+class _PtyTui:
+    def __init__(self, tmp_path):
+        self.blockfile = str(tmp_path / "blocked_items.json")
+        child = tmp_path / "tui_child.py"
+        child.write_text(_CHILD)
+        self.master, slave = pty.openpty()
+        # A real terminal size so the 3-column layout renders.
+        fcntl.ioctl(self.master, termios.TIOCSWINSZ,
+                    struct.pack("HHHH", 40, 140, 0, 0))
+        env = dict(os.environ)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        # Force CPU: the stats callback imports jax, and probing a remote
+        # TPU platform from this child could hang the first frame.
+        env["JAX_PLATFORMS"] = "cpu"
+        # stderr to a FILE: an unread pipe would fill with library logging
+        # and block the child mid-frame.
+        self.errfile = tmp_path / "tui_stderr.log"
+        self.proc = subprocess.Popen(
+            [sys.executable, str(child), self.blockfile],
+            stdin=slave, stdout=slave, stderr=open(self.errfile, "w"),
+            env=env,
+        )
+        os.close(slave)
+        self.buf = bytearray()
+        self._lock = threading.Lock()
+        self._drain = threading.Thread(target=self._drain_loop, daemon=True)
+        self._drain.start()
+
+    def _drain_loop(self):
+        while True:
+            try:
+                chunk = os.read(self.master, 65536)
+            except OSError:
+                return
+            if not chunk:
+                return
+            with self._lock:
+                self.buf += chunk
+
+    def wait_output(self, needle: bytes, budget: float = 60.0) -> bool:
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            with self._lock:
+                if needle in self.buf:
+                    return True
+            time.sleep(0.05)
+        return False
+
+    def clear(self):
+        with self._lock:
+            self.buf.clear()
+
+    def send(self, keys: str):
+        os.write(self.master, keys.encode())
+
+    def close(self):
+        try:
+            os.close(self.master)
+        except OSError:
+            pass
+        if self.proc.poll() is None:
+            self.proc.kill()
+        self.proc.wait(timeout=10)
+
+
+def _blocked_items(path, budget=30.0, want=None):
+    """Poll blocked_items.json until it exists (and contains `want`)."""
+    deadline = time.monotonic() + budget
+    items = None
+    while time.monotonic() < deadline:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            items = data.get("blocked_users", []) + data.get("blocked_ips", [])
+        except (OSError, ValueError):
+            items = None
+        if items is not None and (want is None or want in items):
+            return items
+        time.sleep(0.1)
+    return items
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="pty/termios test")
+def test_tui_admin_verbs_via_pty(tmp_path):
+    t = _PtyTui(tmp_path)
+    try:
+        # Frame renders with both users queued.
+        assert t.wait_output(b"USERS"), _stderr(t)
+        assert t.wait_output(b"alice") and t.wait_output(b"bob")
+
+        # Panel 1, first user (sorted: alice), VIP toggle => star glyph.
+        t.send("\t")
+        t.send("p")
+        assert t.wait_output("★".encode()), "VIP star never rendered"
+
+        # Block => persists to blocked_items.json (reference-compatible).
+        t.send("x")
+        items = _blocked_items(t.blockfile, want="alice")
+        assert items is not None and "alice" in items, items
+        assert t.wait_output("✖".encode())  # blocked glyph in frames
+
+        # Unblock from the blocked panel (Tab Tab => panel 3).
+        t.send("ll")
+        t.send("u")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            items = _blocked_items(t.blockfile)
+            if items == []:
+                break
+            time.sleep(0.1)
+        assert items == [], items
+
+        # Quit: clean exit, like the reference (quit ends the app).
+        t.clear()
+        t.send("q")
+        assert t.wait_output(b"TUI_EXIT_OK"), _stderr(t)
+        assert t.proc.wait(timeout=30) == 0
+    finally:
+        t.close()
+
+
+def _stderr(t):
+    try:
+        return t.errfile.read_text(errors="replace")[-2000:]
+    except Exception:
+        return "<no stderr>"
