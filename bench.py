@@ -53,6 +53,10 @@ def main() -> int:
     p.add_argument("--long-prompt", type=int, default=0,
                    help="if >0, also time chunked prefill of a prompt this "
                         "long (should exceed the largest bucket)")
+    p.add_argument("--sweep-chunks", default="",
+                   help="comma-separated extra decode-chunk sizes to sweep "
+                        "(same runtime; batch reset between legs); the "
+                        "headline number is the best leg")
     p.add_argument("--embed-model", default="",
                    help="if set, also measure embedding batch throughput "
                         "on this encoder model (BASELINE config 3)")
@@ -214,15 +218,40 @@ def main() -> int:
         run_long(0)  # compile
         long_ms = statistics.median(run_long(i) for i in range(1, 4))
 
-    # Fill every slot.
-    for i in range(args.slots):
-        rt.pending_prefill.append(make_req(i))
-        rt.step_prefill(core)
-    active = rt.active_count()
+    from ollamamq_tpu.engine.request import FinishReason
 
-    # Warmup (compiles the decode chunk). If the Pallas kernel fails to
-    # compile on this hardware, fall back to the jnp attention path rather
-    # than losing the benchmark run.
+    def reset_batch():
+        """Finish every slot and re-prefill a fresh full batch, so each
+        sweep leg starts from the same context length / page budget."""
+        for s, r in enumerate(rt.slot_req):
+            if r is not None:
+                rt._finish_slot(s, FinishReason.CANCELLED, core)
+        for i in range(args.slots):
+            rt.pending_prefill.append(make_req(i))
+            rt.step_prefill(core)
+        return rt.active_count()
+
+    def timed_decode(chunk):
+        """Warmup (compiles this chunk size) + timed run; returns
+        (steps_done, elapsed_s)."""
+        rt.step_decode(core, k_steps=chunk)
+        warm_remaining = max(0, args.warmup_steps - chunk)
+        while warm_remaining > 0:
+            rt.step_decode(core, k_steps=chunk)
+            warm_remaining -= chunk
+        done = 0
+        t0 = time.monotonic()
+        while done < args.steps:
+            if rt.step_decode(core, k_steps=chunk) == 0:
+                break
+            done += chunk
+        return done, time.monotonic() - t0
+
+    active = reset_batch()
+
+    # First dispatch compiles the decode chunk. If the Pallas kernel fails
+    # to compile on this hardware, fall back to the jnp attention path
+    # rather than losing the benchmark run.
     attn_fallback = False
     try:
         rt.step_decode(core, k_steps=args.chunk)
@@ -236,22 +265,30 @@ def main() -> int:
             rt.step_decode(core, k_steps=args.chunk)
         else:
             raise
-    warm_remaining = max(0, args.warmup_steps - args.chunk)
-    while warm_remaining > 0:
-        rt.step_decode(core, k_steps=args.chunk)
-        warm_remaining -= args.chunk
 
-    # Timed run.
-    done_steps = 0
-    t0 = time.monotonic()
-    while done_steps < args.steps:
-        emitted = rt.step_decode(core, k_steps=args.chunk)
-        if emitted == 0:
-            break
-        done_steps += args.chunk
-    elapsed = time.monotonic() - t0
-    tokens = active * done_steps
-    tok_per_s = tokens / elapsed if elapsed > 0 else 0.0
+    sweep = []
+    chunks = [args.chunk] + [
+        int(c) for c in args.sweep_chunks.split(",") if c.strip()
+        and int(c) != args.chunk
+    ]
+    for leg_chunk in chunks:
+        if leg_chunk != chunks[0]:
+            active = reset_batch()
+        done, el = timed_decode(leg_chunk)
+        leg_tok_s = active * done / el if el > 0 else 0.0
+        sweep.append({"chunk": leg_chunk, "tok_per_s": round(leg_tok_s, 1),
+                      "steps": done, "elapsed_s": el,
+                      "step_ms": round(el / done * 1e3, 3) if done else None})
+    best = max(sweep, key=lambda s: s["tok_per_s"])
+    if best["steps"] == 0:
+        _emit_error("decode made no progress (page budget too small for "
+                    "the prompt/steps requested?)", device=str(dev))
+        return 5
+    done_steps, elapsed = best["steps"], best.pop("elapsed_s")
+    for leg in sweep:
+        leg.pop("elapsed_s", None)
+    tok_per_s = best["tok_per_s"]
+    best_chunk = best["chunk"]
 
     # Embedding throughput (BASELINE config 3: /api/embed batches). A
     # failure here (second model's weights may not fit next to the decode
@@ -326,7 +363,7 @@ def main() -> int:
         "slots": active,
         "prompt_len": args.prompt_len,
         "decode_steps": done_steps,
-        "chunk": args.chunk,
+        "chunk": best_chunk,
         "step_ms": round(step_s * 1e3, 3),
         "ttft_p50_ms": round(ttft_p50_ms, 1),
         "ttft_compile_ms": round(ttft_compile_ms, 1),
@@ -334,6 +371,8 @@ def main() -> int:
         "attn_impl": rt.attn_impl,
         "attn_fallback": attn_fallback,
     }
+    if len(sweep) > 1:
+        result["sweep"] = sweep
     if long_ms is not None:
         result["long_prompt_len"] = args.long_prompt
         result["long_prefill_ms"] = round(long_ms, 1)

@@ -47,7 +47,8 @@ from ollamamq_tpu.engine.tokenizer import load_tokenizer
 from ollamamq_tpu.models import llama, weights
 from ollamamq_tpu.ops.sampling import (maybe_apply_penalties, per_row_keys,
                                        sample_tokens_rowwise, sampling_flags)
-from ollamamq_tpu.parallel.mesh import make_mesh, validate_tp_for_model
+from ollamamq_tpu.parallel.mesh import (make_mesh, replica_submesh,
+                                        validate_tp_for_model)
 from ollamamq_tpu.parallel.sharding import kv_cache_spec, shard_params
 
 log = logging.getLogger("ollamamq.engine")
@@ -73,6 +74,8 @@ def sweep_blocked(core: MQCore, held_fn, last_version: int) -> int:
 
 class ModelRuntime:
     """Per-model decode state: KV pool, slot table, compiled step fns."""
+
+    SERVES = ("generate",)  # request kinds this runtime can complete
 
     def __init__(
         self,
@@ -948,6 +951,8 @@ class ModelRuntime:
 
 
 class EncoderRuntime:
+
+    SERVES = ("embed",)
     """Embedding model runtime: batch encode, no KV cache."""
 
     def __init__(self, name, model_cfg, engine_cfg, mesh=None,
@@ -1084,8 +1089,6 @@ def build_model_runtimes(name, cfg, engine_cfg, mesh, dtype, checkpoint_path,
     concurrently — the reference's "one request per backend, N backends"
     scale-out story with backends = mesh slices. The checkpoint is
     read/parsed once and shared host-side across replicas."""
-    from jax.sharding import Mesh
-
     if cfg.is_encoder:
         return [encoder_cls(name, cfg, engine_cfg, mesh=mesh,
                             checkpoint_path=checkpoint_path, dtype=dtype)]
@@ -1094,8 +1097,7 @@ def build_model_runtimes(name, cfg, engine_cfg, mesh, dtype, checkpoint_path,
             cfg, checkpoint_path, seed=engine_cfg.seed, dtype=dtype
         )
         reps = [
-            model_cls(name, cfg, engine_cfg,
-                      mesh=Mesh(mesh.devices[r:r + 1], mesh.axis_names),
+            model_cls(name, cfg, engine_cfg, mesh=replica_submesh(mesh, r),
                       checkpoint_path=checkpoint_path, dtype=dtype,
                       preloaded_params=host_params)
             for r in range(engine_cfg.dp)
@@ -1423,10 +1425,8 @@ class TPUEngine:
             # filter keeps a generative request off an EncoderRuntime when
             # only encoders are loaded: it would "finish" with an embedding
             # and no tokens.
-            want_encoder = kind == "embed"
-
             def kind_ok(rt):
-                return isinstance(rt, EncoderRuntime) == want_encoder
+                return kind in getattr(rt, "SERVES", ("generate",))
 
             for rt in self.runtimes.values():
                 if isinstance(rt, ReplicaSet) and kind_ok(rt.replicas[0]) \
@@ -1564,6 +1564,19 @@ class TPUEngine:
         if rt is None:
             self.core.mark_dropped(user, started=False)
             req.finish(FinishReason.ERROR, error=f"model not loaded: {model}")
+            return False
+        # Named-model kind check: generate on an encoder would "finish"
+        # with an embedding and zero tokens; embed on a generative model
+        # has no encoder forward. Both are permanent mismatches — error,
+        # don't park.
+        probe = rt.replicas[0] if isinstance(rt, ReplicaSet) else rt
+        if req.kind not in getattr(probe, "SERVES", ("generate",)):
+            self.core.mark_dropped(user, started=False)
+            req.finish(FinishReason.ERROR, error=(
+                f"model {model or probe.name} is an embedding-only model"
+                if isinstance(probe, EncoderRuntime)
+                else f"model {model or probe.name} does not support "
+                     "embeddings"))
             return False
         if not rt.submit(req):
             if model:

@@ -770,11 +770,13 @@ def run_worker(
 
 
 def _replica_mesh(mesh, engine_cfg, cfg, ri):
-    from jax.sharding import Mesh
+    from ollamamq_tpu.parallel.mesh import replica_submesh
 
     if cfg.is_encoder or engine_cfg.dp <= 1 or mesh is None:
         return mesh
-    return Mesh(mesh.devices[ri:ri + 1], mesh.axis_names)
+    # Same derivation the primary's build_model_runtimes uses — the
+    # reloaded worker replica must land on the identical device set.
+    return replica_submesh(mesh, ri)
 
 
 def _serialize_multihost() -> bool:

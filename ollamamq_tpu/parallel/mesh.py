@@ -47,18 +47,41 @@ def make_mesh(
     k = dp * sp * tp
     if k > n:
         raise ValueError(f"dp*sp*tp={k} > {n} available devices")
-    if dp > 1 and jax.process_count() > 1:
+    nproc = jax.process_count()
+    if dp > 1 and nproc > 1:
         # Multi-host dp replica serving slices the mesh along the data axis
         # (one submesh per replica). jax.devices() is process-major, so the
         # default dp-outermost layout would give each replica the chips of
         # ONE host — a submesh the other processes can't participate in
         # (multi-controller jit requires every process to own addressable
-        # shards). Arrange dp along the fastest-varying (intra-host) device
-        # index instead so every dp slice spans every process.
-        arr = np.asarray(devices[:k]).reshape(sp, tp, dp).transpose(2, 0, 1)
+        # shards). Give each dp slice (devices_per_process / dp) chips from
+        # EVERY process instead; that requires dp to divide the per-process
+        # chip count — fail loudly otherwise (a replica smaller than one
+        # chip per process cannot span every process at all).
+        if k % nproc != 0:
+            raise ValueError(
+                f"{k} mesh devices not divisible by {nproc} processes")
+        per_proc = k // nproc
+        if per_proc % dp != 0:
+            raise ValueError(
+                f"multi-host dp={dp} needs dp to divide the per-process "
+                f"device count ({per_proc}): each replica must own chips "
+                "on every process for its jit to be a valid "
+                "multi-controller computation")
+        arr = (np.asarray(devices[:k])
+               .reshape(nproc, dp, per_proc // dp)
+               .transpose(1, 0, 2)
+               .reshape(dp, sp, tp))
     else:
         arr = np.asarray(devices[:k]).reshape(dp, sp, tp)
     return Mesh(arr, (AXIS_DATA, AXIS_SEQ, AXIS_TENSOR))
+
+
+def replica_submesh(mesh: Mesh, r: int) -> Mesh:
+    """Replica r's slice of the data axis (a [1, sp, tp] submesh) — THE
+    derivation, shared by the engine's replica construction and the SPMD
+    worker's reload path, which must agree on every host."""
+    return Mesh(mesh.devices[r:r + 1], mesh.axis_names)
 
 
 def single_device_mesh() -> Mesh:
