@@ -45,8 +45,9 @@ def main() -> int:
     p.add_argument("--chunk", type=int, default=16, help="decode steps per dispatch")
     p.add_argument("--warmup-steps", type=int, default=32)
     p.add_argument("--ttft-samples", type=int, default=8)
-    p.add_argument("--page-size", type=int, default=16,
-                   help="KV page size (tokens per page)")
+    p.add_argument("--page-size", type=int, default=32,
+                   help="KV page size (tokens per page); 32 measured "
+                        "faster than 16 on v5e (r3: 1762 vs <1700 tok/s)")
     p.add_argument("--sampled", action="store_true",
                    help="use Ollama-default sampling (temp 0.8, repeat 1.1) "
                         "instead of greedy — exercises the full sampler")

@@ -200,6 +200,30 @@ int64_t mq_enqueue(mq_state *s, const char *user, const char *ip,
   return s->queues[u].back().req_id;
 }
 
+/* Return a popped-but-unplaceable task to the FRONT of its user's queue
+ * (fresh req_id). The reference never pops until it can dispatch (peek,
+ * dispatcher.rs:427-431); when a placement races an evict or capacity
+ * loss we must undo the pop without reordering the user's own requests —
+ * a tail re-enqueue would let their request B serve before their earlier
+ * A. Undoes the pop's global_counter advance so the boost cadence is
+ * unchanged by the race. */
+int64_t mq_requeue_front(mq_state *s, const char *user, const char *ip,
+                         const char *model, int api_family) {
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string u = user ? user : "anonymous";
+  std::string i = ip ? ip : "";
+  if (s->blocked_users.count(u)) return -1;
+  if (!i.empty() && s->blocked_ips.count(i)) return -2;
+  Task t;
+  t.req_id = s->next_req_id++;
+  t.user = u;
+  t.model = model ? model : "";
+  t.api_family = api_family;
+  s->queues[u].push_front(std::move(t));
+  if (s->global_counter > 0) s->global_counter -= 1;
+  return s->queues[u].front().req_id;
+}
+
 int64_t mq_next(mq_state *s, const char *eligible_models, char *out_user,
                 int user_cap, char *out_model, int model_cap) {
   std::lock_guard<std::mutex> g(s->mu);

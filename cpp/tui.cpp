@@ -312,6 +312,38 @@ struct Tui {
       std::snprintf(l, sizeof l, " %s  HBM %s", dev.c_str(),
                     human_bytes(hbm_used).c_str());
     out.push_back(std::string(CYAN) + l + RST);
+    /* One row PER chip (pod-wide under SPMD): the north star's "per-chip
+     * HBM occupancy" — a v5e-16 must not show chip 0 for the pod. */
+    auto chips = stats->get("chips");
+    if (chips && !chips->arr.empty()) {
+      /* Cap the rows so a big pod (v5e-64+) can't push the MODELS list —
+       * the panel the admin verbs operate on — off a 40-row terminal. */
+      int cap = body - 4 - (int)(stats->get("models")
+                                     ? stats->get("models")->arr.size() : 0);
+      if (cap < 2) cap = 2;
+      int shown = 0;
+      for (auto &c : chips->arr) {
+        if (shown >= cap) break;
+        long long id = c->get("id") ? c->get("id")->as_int() : 0;
+        long long proc = c->get("process") ? c->get("process")->as_int() : 0;
+        double cu = c->get("hbm_used") ? c->get("hbm_used")->as_num() : 0;
+        double ct = c->get("hbm_total") ? c->get("hbm_total")->as_num() : 0;
+        if (ct > 0)
+          std::snprintf(l, sizeof l, "  chip %lld (host %lld)  %s/%s (%.0f%%)",
+                        id, proc, human_bytes(cu).c_str(),
+                        human_bytes(ct).c_str(), 100.0 * cu / ct);
+        else
+          std::snprintf(l, sizeof l, "  chip %lld (host %lld)  %s", id, proc,
+                        human_bytes(cu).c_str());
+        out.push_back(std::string(DIM) + l + RST);
+        ++shown;
+      }
+      if ((int)chips->arr.size() > shown) {
+        std::snprintf(l, sizeof l, "  … +%d more chips",
+                      (int)chips->arr.size() - shown);
+        out.push_back(std::string(DIM) + l + RST);
+      }
+    }
     auto models = stats->get("models");
     if (!models) return out;
     int idx = 0;

@@ -24,7 +24,7 @@ _STATS_CB = ctypes.CFUNCTYPE(
 )
 
 
-_hbm_cache = {"ts": 0.0, "used": 0, "total": 0, "device": ""}
+_hbm_cache = {"ts": 0.0, "used": 0, "total": 0, "device": "", "chips": []}
 
 
 def _engine_stats_brief(engine) -> dict:
@@ -43,21 +43,22 @@ def _engine_stats_brief(engine) -> dict:
         used = sum(m["param_bytes"] + m["kv_bytes"] for m in models)
         total = 0
         device = ""
+        chips = []
         try:
-            import jax
-
-            dev = jax.local_devices()[0]
-            device = str(dev)
-            ms = dev.memory_stats()
-            if ms:
-                used = ms.get("bytes_in_use", used)
-                total = ms.get("bytes_limit") or 0
+            chips = engine.chip_stats()  # one row PER chip (pod-wide
+            # under SPMD); aggregates below keep the summary line.
+            if chips:
+                device = chips[0]["device"]
+                used = sum(c["hbm_used"] for c in chips) or used
+                total = sum(c["hbm_total"] for c in chips)
         except Exception:
             pass
-        _hbm_cache.update(ts=now, used=used, total=total, device=device)
+        _hbm_cache.update(ts=now, used=used, total=total, device=device,
+                          chips=chips)
     return {
         "models": models,
         "device": _hbm_cache["device"] or "no-device",
+        "chips": _hbm_cache["chips"],
         "hbm_used": _hbm_cache["used"],
         "hbm_total": _hbm_cache["total"],
     }

@@ -58,6 +58,8 @@ def _load() -> ctypes.CDLL:
     lib.mq_enqueue.restype = ctypes.c_int64
     lib.mq_enqueue.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
                                ctypes.c_char_p, ctypes.c_int]
+    lib.mq_requeue_front.restype = ctypes.c_int64
+    lib.mq_requeue_front.argtypes = lib.mq_enqueue.argtypes
     lib.mq_next.restype = ctypes.c_int64
     lib.mq_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                             ctypes.c_char_p, ctypes.c_int,
@@ -143,6 +145,27 @@ class MQCore:
     ) -> int:
         """Returns req_id > 0, or raises BlockedError."""
         rid = self._lib.mq_enqueue(
+            self._h, user.encode(), ip.encode(),
+            model.encode() if model else None, int(family),
+        )
+        if rid == BLOCKED_USER:
+            raise BlockedError("user", user)
+        if rid == BLOCKED_IP:
+            raise BlockedError("ip", ip)
+        return rid
+
+    def requeue_front(
+        self,
+        user: str,
+        ip: str = "",
+        model: Optional[str] = None,
+        family: Family = Family.UNKNOWN,
+    ) -> int:
+        """Undo a pop whose placement raced away: the task returns to the
+        FRONT of its user's queue (per-user FIFO preserved — the reference
+        peeks and never pops until dispatchable, dispatcher.rs:427-431).
+        Returns the fresh req_id, or raises BlockedError."""
+        rid = self._lib.mq_requeue_front(
             self._h, user.encode(), ip.encode(),
             model.encode() if model else None, int(family),
         )

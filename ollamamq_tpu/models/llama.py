@@ -305,6 +305,36 @@ def forward_prefill_sp(
     return logits, k_stack, v_stack
 
 
+def forward_embed(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, T]
+    seq_lens: jnp.ndarray,  # [B]
+) -> jnp.ndarray:
+    """Embeddings from a GENERATIVE model: causal forward (no KV write),
+    masked mean pool of the final-norm hidden states, L2 norm — llama.cpp's
+    default pooling for causal models, which is what the reference's Ollama
+    backends run for /api/embed on e.g. llama3 (README.md /api/embed row).
+    """
+    B, T = tokens.shape
+    x = params["embed"][tokens].astype(params["embed"].dtype)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+
+    def body(carry, lp):
+        x = carry
+        x, _, _ = _layer_step(
+            cfg, lp, x, positions,
+            lambda q, k, v: causal_attention(q, k, v, seq_lens),
+        )
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps).astype(jnp.float32)
+    mask = (positions < seq_lens[:, None]).astype(jnp.float32)[:, :, None]
+    pooled = jnp.sum(x * mask, axis=1) / jnp.maximum(jnp.sum(mask, axis=1), 1.0)
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)
+
+
 def forward_encoder(
     params: dict,
     cfg: ModelConfig,

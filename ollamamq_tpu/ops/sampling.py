@@ -206,24 +206,6 @@ def maybe_apply_penalties(logits, recent, repeat, presence, frequency,
     return apply_penalties(logits, recent, repeat, presence, frequency)
 
 
-def sample_tokens(
-    logits: jnp.ndarray,  # [B, V] float32
-    key: jax.Array,
-    temperature: jnp.ndarray,  # [B]
-    top_k: jnp.ndarray,  # [B] int32 (0 = off)
-    top_p: jnp.ndarray,  # [B]
-    need_mask: bool = True,
-    need_sample: bool = True,
-) -> jnp.ndarray:
-    """Vectorized per-sequence sampling. Greedy where temperature == 0."""
-    masked, greedy = _masked_scaled_logits(logits, temperature, top_k, top_p,
-                                           need_mask)
-    if not need_sample:
-        return greedy.astype(jnp.int32)
-    sampled = jax.random.categorical(key, masked, axis=-1)
-    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
-
-
 def per_row_keys(
     key: jax.Array,  # engine-stream key for this dispatch
     seeds: jnp.ndarray,  # [B] int32; >0 = request-provided seed

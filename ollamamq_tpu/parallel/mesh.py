@@ -68,13 +68,36 @@ def make_mesh(
                 f"device count ({per_proc}): each replica must own chips "
                 "on every process for its jit to be a valid "
                 "multi-controller computation")
-        arr = (np.asarray(devices[:k])
+        arr = (np.asarray(_pick_per_process(devices, k, nproc, per_proc))
                .reshape(nproc, dp, per_proc // dp)
                .transpose(1, 0, 2)
                .reshape(dp, sp, tp))
     else:
         arr = np.asarray(devices[:k]).reshape(dp, sp, tp)
     return Mesh(arr, (AXIS_DATA, AXIS_SEQ, AXIS_TENSOR))
+
+
+def _pick_per_process(devices, k: int, nproc: int, per_proc: int):
+    """The k devices for a multi-host dp mesh, process-major with exactly
+    per_proc devices FROM EACH PROCESS. `devices[:k]` alone is wrong when
+    k < len(devices): jax.devices() is process-major, so the first k could
+    all come from the first host(s) and the (nproc, dp, ...) relabeling
+    would silently produce replicas that don't span every process (ADVICE
+    r3). Falls back to the positional split only when the device list
+    doesn't actually carry nproc distinct process_indexes (single-process
+    simulations of a process count, e.g. tests)."""
+    by_proc: dict = {}
+    for d in devices:
+        by_proc.setdefault(getattr(d, "process_index", 0), []).append(d)
+    if len(by_proc) != nproc:
+        return devices[:k]
+    short = {p: len(v) for p, v in by_proc.items() if len(v) < per_proc}
+    if short:
+        raise ValueError(
+            f"multi-host dp mesh needs {per_proc} devices from every "
+            f"process; process(es) {sorted(short)} have only "
+            f"{sorted(short.values())}")
+    return [d for p in sorted(by_proc) for d in by_proc[p][:per_proc]]
 
 
 def replica_submesh(mesh: Mesh, r: int) -> Mesh:

@@ -171,6 +171,22 @@ def test_encoder_embeddings():
     np.testing.assert_allclose(np.asarray(emb[0]), np.asarray(emb2[0]), rtol=1e-4, atol=1e-5)
 
 
+def test_forward_embed_generative():
+    """Embeddings from a CAUSAL model: normalized, padding-invariant."""
+    cfg = MODEL_CONFIGS["test-tiny"]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    toks = jnp.array([[1, 2, 3, 0, 0], [4, 5, 6, 7, 8]], jnp.int32)
+    emb = llama.forward_embed(params, cfg, toks, jnp.array([3, 5]))
+    assert emb.shape == (2, cfg.hidden_size)
+    np.testing.assert_allclose(
+        np.asarray(jnp.linalg.norm(emb, axis=-1)), 1.0, rtol=1e-5)
+    emb2 = llama.forward_embed(
+        params, cfg, jnp.array([[1, 2, 3]], jnp.int32), jnp.array([3])
+    )
+    np.testing.assert_allclose(
+        np.asarray(emb[0]), np.asarray(emb2[0]), rtol=1e-4, atol=1e-5)
+
+
 def test_chunked_prefill_equivalence(tiny_cfg, tiny_params):
     """Chaining forward_prefill_chunk chunks == one-shot forward_prefill."""
     cfg, params = tiny_cfg, tiny_params

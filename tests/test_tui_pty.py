@@ -34,7 +34,12 @@ from ollamamq_tpu.admin import tui as admin_tui
 # is about the key loop and persistence, not devices — pin the cache so
 # the jax branch never runs.
 admin_tui._hbm_cache.update(
-    ts=float("inf"), used=0, total=0, device="test-device"
+    ts=float("inf"), used=0, total=0, device="test-device",
+    # 8 chips across 2 simulated hosts: the chips panel must render one
+    # row per chip (north star "per-chip HBM occupancy").
+    chips=[{"device": f"cpu:{i}", "id": i, "process": i // 4,
+            "hbm_used": (i + 1) << 20, "hbm_total": 16 << 20}
+           for i in range(8)],
 )
 
 core = MQCore(sys.argv[1])
@@ -144,6 +149,10 @@ def test_tui_admin_verbs_via_pty(tmp_path):
         # Frame renders with both users queued.
         assert t.wait_output(b"USERS"), _stderr(t)
         assert t.wait_output(b"alice") and t.wait_output(b"bob")
+
+        # Per-chip rows: one line per chip, both hosts represented.
+        assert t.wait_output(b"chip 0 (host 0)"), "per-chip rows missing"
+        assert t.wait_output(b"chip 7 (host 1)"), "per-chip rows missing"
 
         # Panel 1, first user (sorted: alice), VIP toggle => star glyph.
         t.send("\t")
