@@ -21,6 +21,7 @@ struct Task {
   std::string user;
   std::string model;  // empty = none requested
   int api_family;
+  int kind = 0;  // MQ_KIND_GENERATE / MQ_KIND_EMBED
 };
 
 std::string lower(const std::string &s) {
@@ -183,8 +184,8 @@ mq_state *mq_new(const char *blocklist_path) {
 
 void mq_destroy(mq_state *s) { delete s; }
 
-int64_t mq_enqueue(mq_state *s, const char *user, const char *ip,
-                   const char *model, int api_family) {
+int64_t mq_enqueue_kind(mq_state *s, const char *user, const char *ip,
+                        const char *model, int api_family, int kind) {
   std::lock_guard<std::mutex> g(s->mu);
   std::string u = user ? user : "anonymous";
   std::string i = ip ? ip : "";
@@ -196,8 +197,14 @@ int64_t mq_enqueue(mq_state *s, const char *user, const char *ip,
   t.user = u;
   t.model = model ? model : "";
   t.api_family = api_family;
+  t.kind = kind;
   s->queues[u].push_back(std::move(t));
   return s->queues[u].back().req_id;
+}
+
+int64_t mq_enqueue(mq_state *s, const char *user, const char *ip,
+                   const char *model, int api_family) {
+  return mq_enqueue_kind(s, user, ip, model, api_family, MQ_KIND_GENERATE);
 }
 
 /* Return a popped-but-unplaceable task to the FRONT of its user's queue
@@ -208,7 +215,7 @@ int64_t mq_enqueue(mq_state *s, const char *user, const char *ip,
  * A. Undoes the pop's global_counter advance so the boost cadence is
  * unchanged by the race. */
 int64_t mq_requeue_front(mq_state *s, const char *user, const char *ip,
-                         const char *model, int api_family) {
+                         const char *model, int api_family, int kind) {
   std::lock_guard<std::mutex> g(s->mu);
   std::string u = user ? user : "anonymous";
   std::string i = ip ? ip : "";
@@ -219,6 +226,7 @@ int64_t mq_requeue_front(mq_state *s, const char *user, const char *ip,
   t.user = u;
   t.model = model ? model : "";
   t.api_family = api_family;
+  t.kind = kind;
   s->queues[u].push_front(std::move(t));
   if (s->global_counter > 0) s->global_counter -= 1;
   return s->queues[u].front().req_id;
@@ -226,6 +234,13 @@ int64_t mq_requeue_front(mq_state *s, const char *user, const char *ip,
 
 int64_t mq_next(mq_state *s, const char *eligible_models, char *out_user,
                 int user_cap, char *out_model, int model_cap) {
+  return mq_next2(s, eligible_models, nullptr, out_user, user_cap, out_model,
+                  model_cap);
+}
+
+int64_t mq_next2(mq_state *s, const char *eligible_generate,
+                 const char *eligible_embed, char *out_user, int user_cap,
+                 char *out_model, int model_cap) {
   std::lock_guard<std::mutex> g(s->mu);
 
   std::vector<std::string> active;
@@ -259,10 +274,18 @@ int64_t mq_next(mq_state *s, const char *eligible_models, char *out_user,
   Task &front = s->queues[target].front();
 
   /* Model/capability gate: the TPU-era analogue of the backend filter
-   * (dispatcher.rs:444-465). NULL => everything eligible. */
-  if (eligible_models != nullptr && !front.model.empty()) {
+   * (dispatcher.rs:444-465). The list is chosen by the front task's KIND
+   * — embed capacity (stateless batch forwards) and generate capacity
+   * (decode slots + KV pages) are independent pools, so a saturated
+   * decode batch must not park embeds and vice versa. NULL embed list =>
+   * kind-blind (generate list for everything); NULL generate list =>
+   * everything eligible. */
+  const char *eligible = (front.kind == MQ_KIND_EMBED && eligible_embed)
+                             ? eligible_embed
+                             : eligible_generate;
+  if (eligible != nullptr && !front.model.empty()) {
     std::vector<std::string> have;
-    std::stringstream ss(eligible_models);
+    std::stringstream ss(eligible);
     std::string line;
     while (std::getline(ss, line, '\n'))
       if (!line.empty()) have.push_back(line);

@@ -51,10 +51,23 @@ enum { MQ_EMPTY = 0, MQ_STUCK = -1 };
 mq_state *mq_new(const char *blocklist_path);
 void mq_destroy(mq_state *);
 
+/* Request kinds (engine work classes with separate capacity pools). */
+enum { MQ_KIND_GENERATE = 0, MQ_KIND_EMBED = 1 };
+
 /* Enqueue. Returns req_id > 0, or -1 if user blocked, -2 if IP blocked.
  * Also records user->ip (dispatcher.rs:612-615). */
 int64_t mq_enqueue(mq_state *, const char *user, const char *ip,
                    const char *model /*nullable*/, int api_family);
+/* Enqueue with an explicit request kind (mq_enqueue = kind GENERATE). */
+int64_t mq_enqueue_kind(mq_state *, const char *user, const char *ip,
+                        const char *model /*nullable*/, int api_family,
+                        int kind);
+/* Return a popped-but-unplaceable task to the FRONT of its user's queue
+ * (fresh req_id; FIFO preserved — the reference peeks and never pops
+ * until dispatchable, dispatcher.rs:427-431). */
+int64_t mq_requeue_front(mq_state *, const char *user, const char *ip,
+                         const char *model /*nullable*/, int api_family,
+                         int kind);
 
 /* Pick per policy. eligible_models: '\n'-separated model names the engine
  * can serve right now (empty string => nothing loaded; NULL => everything
@@ -63,6 +76,15 @@ int64_t mq_enqueue(mq_state *, const char *user, const char *ip,
 int64_t mq_next(mq_state *, const char *eligible_models,
                 char *out_user, int user_cap,
                 char *out_model, int model_cap);
+/* Kind-aware pick: the gate list is chosen by the FRONT task's kind, so
+ * embed capacity and decode-slot capacity are independent pools (a full
+ * decode batch must not park embeds, and a deep embed backlog must not
+ * park generates). eligible_embed == NULL falls back to
+ * eligible_generate (kind-blind behavior). */
+int64_t mq_next2(mq_state *, const char *eligible_generate,
+                 const char *eligible_embed,
+                 char *out_user, int user_cap,
+                 char *out_model, int model_cap);
 
 /* Remove a still-queued request (client cancel/disconnect before dispatch).
  * Returns 1 if found+removed (counts dropped), 0 otherwise. */

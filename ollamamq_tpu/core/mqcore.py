@@ -58,8 +58,15 @@ def _load() -> ctypes.CDLL:
     lib.mq_enqueue.restype = ctypes.c_int64
     lib.mq_enqueue.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
                                ctypes.c_char_p, ctypes.c_int]
+    lib.mq_enqueue_kind.restype = ctypes.c_int64
+    lib.mq_enqueue_kind.argtypes = lib.mq_enqueue.argtypes + [ctypes.c_int]
     lib.mq_requeue_front.restype = ctypes.c_int64
-    lib.mq_requeue_front.argtypes = lib.mq_enqueue.argtypes
+    lib.mq_requeue_front.argtypes = lib.mq_enqueue_kind.argtypes
+    lib.mq_next2.restype = ctypes.c_int64
+    lib.mq_next2.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                             ctypes.c_char_p,
+                             ctypes.c_char_p, ctypes.c_int,
+                             ctypes.c_char_p, ctypes.c_int]
     lib.mq_next.restype = ctypes.c_int64
     lib.mq_next.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                             ctypes.c_char_p, ctypes.c_int,
@@ -142,11 +149,15 @@ class MQCore:
         ip: str = "",
         model: Optional[str] = None,
         family: Family = Family.UNKNOWN,
+        kind: str = "generate",
     ) -> int:
-        """Returns req_id > 0, or raises BlockedError."""
-        rid = self._lib.mq_enqueue(
+        """Returns req_id > 0, or raises BlockedError. `kind` selects the
+        capacity pool the scheduler gate checks for this task (embed vs
+        generate are independent engine resources)."""
+        rid = self._lib.mq_enqueue_kind(
             self._h, user.encode(), ip.encode(),
             model.encode() if model else None, int(family),
+            1 if kind == "embed" else 0,
         )
         if rid == BLOCKED_USER:
             raise BlockedError("user", user)
@@ -160,6 +171,7 @@ class MQCore:
         ip: str = "",
         model: Optional[str] = None,
         family: Family = Family.UNKNOWN,
+        kind: str = "generate",
     ) -> int:
         """Undo a pop whose placement raced away: the task returns to the
         FRONT of its user's queue (per-user FIFO preserved — the reference
@@ -168,6 +180,7 @@ class MQCore:
         rid = self._lib.mq_requeue_front(
             self._h, user.encode(), ip.encode(),
             model.encode() if model else None, int(family),
+            1 if kind == "embed" else 0,
         )
         if rid == BLOCKED_USER:
             raise BlockedError("user", user)
@@ -176,16 +189,25 @@ class MQCore:
         return rid
 
     def next(
-        self, eligible_models: Optional[Iterable[str]] = None
+        self, eligible_models: Optional[Iterable[str]] = None,
+        eligible_embed: Optional[Iterable[str]] = None,
     ) -> Optional[Tuple[int, str, str]]:
         """Pop per policy. Returns (req_id, user, model) or None (empty).
-        Raises StuckQueue if the policy pick's model isn't servable."""
+        Raises StuckQueue if the policy pick's model isn't servable.
+        `eligible_embed`, when given, gates embed-kind tasks instead of
+        `eligible_models` — the two capacity pools are independent (a
+        full decode batch must not park embeds and vice versa); None
+        keeps the kind-blind single-list behavior."""
         ubuf = ctypes.create_string_buffer(512)
         mbuf = ctypes.create_string_buffer(512)
         em = None
         if eligible_models is not None:
             em = "\n".join(eligible_models).encode()
-        rid = self._lib.mq_next(self._h, em, ubuf, len(ubuf), mbuf, len(mbuf))
+        ee = None
+        if eligible_embed is not None:
+            ee = "\n".join(eligible_embed).encode()
+        rid = self._lib.mq_next2(self._h, em, ee, ubuf, len(ubuf), mbuf,
+                                 len(mbuf))
         if rid == EMPTY:
             return None
         if rid == STUCK:

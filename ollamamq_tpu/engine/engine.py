@@ -316,17 +316,25 @@ class ModelRuntime:
             for i, r in enumerate(self.slot_req)
         )
 
-    def has_capacity(self) -> bool:
-        """Can we take one more request from the scheduler right now?"""
-        return (
-            not self._failed
-            # Embeds hold no slot/pages but must still be bounded (same
-            # 4x ceiling as EncoderRuntime's queue).
-            and len(self.pending_embed) < 4 * self.ecfg.max_slots
-            and len(self.pending_prefill) < 2 * self.ecfg.max_slots
+    def has_capacity(self, kind: Optional[str] = None) -> bool:
+        """Can we take one more request from the scheduler right now?
+
+        Kind-aware: embeds are stateless batch forwards bounded only by
+        their queue (same 4x ceiling as EncoderRuntime), while generates
+        need a decode slot + KV pages — independent pools, so a full
+        decode batch must not park embeds and a deep embed backlog must
+        not park generates. kind=None answers "either"."""
+        if self._failed:
+            return False
+        embed_ok = len(self.pending_embed) < 4 * self.ecfg.max_slots
+        if kind == "embed":
+            return embed_ok
+        gen_ok = (
+            len(self.pending_prefill) < 2 * self.ecfg.max_slots
             and self.free_slots() > 0
             and self.alloc.free_pages >= 2
         )
+        return gen_ok if kind == "generate" else (gen_ok or embed_ok)
 
     def has_work(self) -> bool:
         return (
@@ -1134,7 +1142,7 @@ class EncoderRuntime:
         self.tokens_generated = 0
         self.step_latency_ms = 0.0
 
-    def has_capacity(self) -> bool:
+    def has_capacity(self, kind: Optional[str] = None) -> bool:
         return not self._failed and len(self.pending) < 4 * self.ecfg.max_slots
 
     def has_work(self) -> bool:
@@ -1249,8 +1257,8 @@ class ReplicaSet:
                 + len(getattr(rt, "pending_embed", ()))
                 + len(rt.chunking))
 
-    def has_capacity(self) -> bool:
-        return any(r.has_capacity() for r in self.replicas)
+    def has_capacity(self, kind: Optional[str] = None) -> bool:
+        return any(r.has_capacity(kind) for r in self.replicas)
 
     def submit(self, req: Request) -> bool:
         """Least-loaded replica wins; ties rotate after the previous pick.
@@ -1259,7 +1267,8 @@ class ReplicaSet:
         reference's wait-in-queue semantics (dispatcher.rs:467-473) —
         instead of parking it on a full replica where it would jump the
         fair-share order."""
-        eligible = [i for i, r in enumerate(self.replicas) if r.has_capacity()]
+        eligible = [i for i, r in enumerate(self.replicas)
+                    if r.has_capacity(req.kind)]
         if not eligible:
             return False
         best = min(self._load(self.replicas[i]) for i in eligible)
@@ -1428,7 +1437,8 @@ class TPUEngine:
         Raises BlockedError for blocked users/IPs."""
         with self._pending_lock:
             rid = self.core.enqueue(
-                user, ip, model, family if family is not None else Family.UNKNOWN
+                user, ip, model,
+                family if family is not None else Family.UNKNOWN, kind=kind,
             )
             req = Request(rid, user, model, prompt_tokens or [], sampling,
                           kind=kind, raw_prompt=raw_prompt)
@@ -1594,6 +1604,17 @@ class TPUEngine:
             self.health.stop()
             self.health = None
 
+    @staticmethod
+    def _gate_eligible(rt, kind: str) -> bool:
+        """Gate-eligibility of a runtime for one request kind: it can
+        accept one NOW, or it permanently cannot serve the kind — then
+        the pop must still reach _place so the mismatch errors loudly
+        (never parks as unservable)."""
+        probe = rt.replicas[0] if isinstance(rt, ReplicaSet) else rt
+        if kind not in getattr(probe, "SERVES", ("generate",)):
+            return True
+        return rt.has_capacity(kind)
+
     def _admit(self) -> int:
         admitted = 0
         # Retry orphans: ids popped before their Request was registered
@@ -1617,8 +1638,9 @@ class TPUEngine:
             if req is None:
                 continue  # still within grace, not yet registered
             rt = self.resolve_runtime(model, kind=req.kind)
-            if rt is not None and not rt.has_capacity():
-                # Runtime full: put the Request back and retry later.
+            if rt is not None and not self._gate_eligible(rt, req.kind):
+                # Runtime full for this kind: put the Request back and
+                # retry later.
                 with self._pending_lock:
                     self.pending[rid] = req
                 continue
@@ -1630,13 +1652,18 @@ class TPUEngine:
             if now - ts > 60.0:
                 del self._expired_orphans[rid]
         while True:
-            eligible = [
-                name for name, rt in self.runtimes.items() if rt.has_capacity()
-            ]
-            if not eligible:
+            # Two capacity pools, one gate each: the native pop gates an
+            # embed task on the embed list and a generate task on the
+            # generate list, so neither kind's backlog parks the other.
+            gen_ok = [name for name, rt in self.runtimes.items()
+                      if self._gate_eligible(rt, "generate")]
+            emb_ok = [name for name, rt in self.runtimes.items()
+                      if self._gate_eligible(rt, "embed")]
+            if not gen_ok and not emb_ok:
                 break
             try:
-                item = self.core.next(eligible_models=eligible)
+                item = self.core.next(eligible_models=gen_ok,
+                                      eligible_embed=emb_ok)
             except StuckQueue:
                 # Policy pick unservable; cursor advanced, retry on wake.
                 # Rate-limited warn for operator visibility (the reference
@@ -1646,8 +1673,9 @@ class TPUEngine:
                     self._last_stuck_log = now
                     log.warning(
                         "request stuck in queue: scheduler pick needs a model "
-                        "not currently servable (loaded: %s; %d queued)",
-                        eligible, self.core.total_queued(),
+                        "not currently servable (generate-ready: %s, "
+                        "embed-ready: %s; %d queued)",
+                        gen_ok, emb_ok, self.core.total_queued(),
                     )
                 break
             if item is None:
@@ -1717,7 +1745,8 @@ class TPUEngine:
         this one). Always returns False (nothing was placed)."""
         try:
             with self._pending_lock:
-                new_rid = self.core.requeue_front(user, "", model)
+                new_rid = self.core.requeue_front(user, "", model,
+                                                  kind=req.kind)
                 req.req_id = new_rid
                 self.pending[new_rid] = req
         except BlockedError:
@@ -1819,7 +1848,7 @@ class TPUEngine:
                         waiting = bool(rt.pending_prefill) or bool(
                             self.core.queued_matching(rt.name)
                         )
-                        can_admit = waiting and rt.has_capacity()
+                        can_admit = waiting and rt.has_capacity("generate")
                         k = (1 if (can_admit or rt.chunking)
                              else self.ecfg.decode_steps_per_iter)
                         h = rt.step_decode_dispatch(self.core, k_steps=k)

@@ -41,7 +41,7 @@ class FakeRuntime:
         self.param_bytes = 0
         self.kv_bytes = 0
 
-    def has_capacity(self) -> bool:
+    def has_capacity(self, kind=None) -> bool:
         return len(self.active) + len(self.pending_prefill) < self.ecfg.max_slots
 
     def has_work(self) -> bool:

@@ -231,6 +231,37 @@ def test_pallas_failure_falls_back_to_jnp():
         eng.stop()
 
 
+def test_embed_admitted_while_decode_saturated():
+    """An embed request must be served while every decode slot is busy —
+    embeds are stateless forwards with their own capacity pool, so a full
+    decode batch must not park them in the queue."""
+    eng = TPUEngine(small_cfg(max_slots=1, decode_steps_per_iter=1),
+                    blocklist_path=None)
+    eng.start()
+    try:
+        tok = eng.runtimes["test-tiny"].tokenizer
+        # Occupy the ONLY decode slot with a long generation.
+        gen = eng.enqueue_request("genuser", "", "test-tiny",
+                                  prompt_tokens=tok.encode("long"),
+                                  sampling=SamplingParams(max_tokens=100))
+        deadline = time.monotonic() + 60
+        rt = eng.runtimes["test-tiny"]
+        while rt.active_count() == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert rt.active_count() == 1 and not rt.has_capacity("generate")
+        # The embed must complete while that generation still runs.
+        emb = eng.enqueue_request("embuser", "", "test-tiny",
+                                  prompt_tokens=tok.encode("embed me"),
+                                  sampling=SamplingParams(), kind="embed")
+        items = collect(emb, timeout=60)
+        assert items[-1].kind == "done" and emb.embedding is not None
+        assert not gen.finished.is_set(), \
+            "generation finished first: embed waited on a decode slot"
+        gen.cancelled.set()
+    finally:
+        eng.stop()
+
+
 def test_stats_reports_every_chip(engine):
     """stats()['chips'] carries one row PER local device — not device 0
     standing in for the pod (VERDICT r3 weak #6)."""

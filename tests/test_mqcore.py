@@ -42,6 +42,30 @@ def test_fifo_per_user(core):
     assert got == ids  # FIFO order preserved (queues push_back/pop_front)
 
 
+def test_kind_aware_eligibility_gate(core):
+    """Embed and generate tasks gate on SEPARATE eligibility lists: a
+    saturated decode batch (model absent from the generate list) must not
+    park embeds, and vice versa."""
+    a = core.enqueue("alice", model="m1")  # generate kind
+    b = core.enqueue("bob", model="m1", kind="embed")
+    # Decode full: alice's generate pick is STUCK...
+    with pytest.raises(StuckQueue):
+        core.next(eligible_models=[], eligible_embed=["m1"])
+    # ...but bob's embed pops through the embed list.
+    rid, user, _ = core.next(eligible_models=[], eligible_embed=["m1"])
+    assert rid == b and user == "bob"
+    # Mirror image: embed backlog full, generates still flow.
+    rid, user, _ = core.next(eligible_models=["m1"], eligible_embed=[])
+    assert rid == a and user == "alice"
+    # Requeued tasks keep their kind: a requeued embed still gates on the
+    # embed list.
+    e2 = core.requeue_front("bob", model="m1", kind="embed")
+    with pytest.raises(StuckQueue):
+        core.next(eligible_models=["m1"], eligible_embed=[])
+    rid, _, _ = core.next(eligible_models=[], eligible_embed=["m1"])
+    assert rid == e2
+
+
 def test_requeue_front_preserves_fifo(core):
     """A popped-but-unplaceable task returns to the FRONT of its user's
     queue: the user's later request must never overtake it (the reference
