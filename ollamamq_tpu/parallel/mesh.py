@@ -25,28 +25,35 @@ from jax.sharding import Mesh
 AXIS_DATA = "data"
 AXIS_TENSOR = "tensor"
 AXIS_SEQ = "seq"
+AXIS_PIPE = "pipe"
+AXIS_EXPERT = "expert"
 
 
 def make_mesh(
     dp: int = 1,
     tp: int = -1,
     sp: int = 1,
+    pp: int = 1,
+    ep: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build a (data, seq, tensor) mesh.
+    """Build a (data, pipe, seq, expert, tensor) mesh.
 
-    `tp=-1` means "all devices not consumed by dp*sp". The tensor axis is
-    innermost so TP collectives ride the fastest ICI links (adjacent chips).
+    `tp=-1` means "all devices not consumed by dp*pp*sp*ep". The tensor
+    axis is innermost so TP collectives ride the fastest ICI links
+    (adjacent chips); the pipe axis sits next to data (stage handoffs are
+    one ppermute per microbatch step — the lowest-bandwidth traffic).
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if tp == -1:
-        if n % (dp * sp) != 0:
-            raise ValueError(f"{n} devices not divisible by dp*sp={dp * sp}")
-        tp = n // (dp * sp)
-    k = dp * sp * tp
+        if n % (dp * pp * sp * ep) != 0:
+            raise ValueError(
+                f"{n} devices not divisible by dp*pp*sp*ep={dp * pp * sp * ep}")
+        tp = n // (dp * pp * sp * ep)
+    k = dp * pp * sp * ep * tp
     if k > n:
-        raise ValueError(f"dp*sp*tp={k} > {n} available devices")
+        raise ValueError(f"dp*pp*sp*ep*tp={k} > {n} available devices")
     nproc = jax.process_count()
     if dp > 1 and nproc > 1:
         # Multi-host dp replica serving slices the mesh along the data axis
@@ -71,10 +78,10 @@ def make_mesh(
         arr = (np.asarray(_pick_per_process(devices, k, nproc, per_proc))
                .reshape(nproc, dp, per_proc // dp)
                .transpose(1, 0, 2)
-               .reshape(dp, sp, tp))
+               .reshape(dp, pp, sp, ep, tp))
     else:
-        arr = np.asarray(devices[:k]).reshape(dp, sp, tp)
-    return Mesh(arr, (AXIS_DATA, AXIS_SEQ, AXIS_TENSOR))
+        arr = np.asarray(devices[:k]).reshape(dp, pp, sp, ep, tp)
+    return Mesh(arr, (AXIS_DATA, AXIS_PIPE, AXIS_SEQ, AXIS_EXPERT, AXIS_TENSOR))
 
 
 def _pick_per_process(devices, k: int, nproc: int, per_proc: int):

@@ -1,0 +1,345 @@
+"""Pipeline parallelism: layers sharded over the mesh "pipe" axis.
+
+The reference scales by adding whole HTTP backends (one full model copy
+each — /root/reference/src/dispatcher.rs:434-482); it has no way to serve
+a model LARGER than one backend's memory. Pipeline parallelism is that
+missing axis: the stacked layer parameters [L, ...] (already the repo's
+scan-over-layers layout, models/llama.py) shard their leading L dim over
+the "pipe" mesh axis, so each chip group holds only L/P layers' weights
+and L/P layers' KV pages — the per-chip HBM footprint drops by P.
+
+TPU-native schedule (not a translation of GPU send/recv pipelines):
+  - One `jax.shard_map` over the whole mesh; each pipe stage runs the
+    SAME traced program (SPMD), scanning its local layer stack.
+  - GPipe-style microbatching: the batch splits into M microbatches; at
+    schedule step t, stage p works on microbatch (t - p). Activations
+    hand off between stages via a single `lax.ppermute` per step — XLA
+    lowers it to an ICI neighbor copy that overlaps the next stage's
+    compute. M + P - 1 steps drain the pipeline.
+  - Bubble steps (t - p outside [0, M)) compute on garbage and write
+    their K/V to the allocator's trash page (slot 0 — engine/kv_cache.py
+    TRASH_PAGE), keeping every step fully static-shaped: no cond, no
+    dynamic shapes, one compiled program.
+  - Composes with tensor parallelism INSIDE each stage: head/FFN dims
+    stay sharded over "tensor" and the row-parallel matmuls (wo, w_down)
+    reduce via `lax.psum` — identity when tp == 1, Megatron-style TP
+    when tp > 1 (requires num_kv_heads % tp == 0; the replicated-group
+    KV trick is a non-PP path). Embedding and lm_head stay vocab-sharded
+    over "tensor" via masked local lookup + psum.
+
+Numerics match the single-device forwards exactly (same per-layer math,
+same f32 softmax); only the schedule is distributed — pinned by
+tests/test_pipeline.py against forward_prefill/forward_decode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ollamamq_tpu.config import ModelConfig
+from ollamamq_tpu.models.llama import rmsnorm
+from ollamamq_tpu.ops.attention import (
+    causal_attention,
+    flat_slot_indices,
+    paged_decode_attention,
+)
+from ollamamq_tpu.ops.rope import apply_rope
+from ollamamq_tpu.parallel.mesh import AXIS_PIPE, AXIS_TENSOR
+from ollamamq_tpu.parallel.sharding import param_partition_specs
+
+
+def pipeline_param_specs(params: dict) -> dict:
+    """Partition specs for PP(xTP): the usual TP specs, plus every leaf of
+    the stacked `layers` subtree sharded over "pipe" on its leading
+    num_layers dim."""
+    specs = param_partition_specs(params)
+
+    def add_pipe(leaf, spec):
+        dims = list(spec) + [None] * (leaf.ndim - len(spec))
+        dims[0] = AXIS_PIPE
+        return P(*dims)
+
+    specs["layers"] = jax.tree_util.tree_map(
+        add_pipe, params["layers"], specs["layers"]
+    )
+    return specs
+
+
+def n_microbatches(batch: int, pipe: int, requested: Optional[int] = None) -> int:
+    """Microbatch count: the largest divisor of `batch` that is <= the
+    requested count (default: the stage count, which keeps every stage
+    busy in steady state with the fewest handoffs)."""
+    m = min(requested or pipe, batch)
+    while batch % m:
+        m -= 1
+    return max(m, 1)
+
+
+# ---------------------------------------------------------------------------
+# Per-stage layer math (tensor-parallel inside the stage).
+#
+# Mirrors models/llama.py:_layer_step / forward_decode's body, except the
+# head / FFN dims are tensor-LOCAL shards and the row-parallel outputs
+# (wo, w_down) reduce with an explicit psum — under shard_map the
+# collective XLA would otherwise infer from shardings must be written out.
+# ---------------------------------------------------------------------------
+
+
+def _tp_qkv(cfg: ModelConfig, lp: dict, h: jnp.ndarray):
+    B, T, _ = h.shape
+    hd = cfg.head_dim
+    q = jnp.einsum("btd,de->bte", h, lp["wq"])
+    k = jnp.einsum("btd,de->bte", h, lp["wk"])
+    v = jnp.einsum("btd,de->bte", h, lp["wv"])
+    if "bq" in lp:
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    q = q.reshape(B, T, q.shape[-1] // hd, hd)
+    k = k.reshape(B, T, k.shape[-1] // hd, hd)
+    v = v.reshape(B, T, v.shape[-1] // hd, hd)
+    return q, k, v
+
+
+def _tp_mlp(lp: dict, h: jnp.ndarray) -> jnp.ndarray:
+    gate = jnp.einsum("btd,df->btf", h, lp["w_gate"])
+    up = jnp.einsum("btd,df->btf", h, lp["w_up"])
+    down = jnp.einsum("btf,fd->btd", jax.nn.silu(gate) * up, lp["w_down"])
+    return lax.psum(down, AXIS_TENSOR)
+
+
+def _stage_prefill(cfg, layers, x, positions, seq_lens, kc, vc, slots):
+    """Run this stage's local layer stack over one microbatch.
+
+    x: [mb, T, D]; kc/vc: [Lp, S, Hk_loc, hd] local cache slices;
+    slots: [mb, T] flat cache slots (trash-redirected on bubble steps).
+    """
+    B, T, _ = x.shape
+
+    def body(carry, per_layer):
+        x = carry
+        lp, kcl, vcl = per_layer
+        h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _tp_qkv(cfg, lp, h)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        kcl = kcl.at[slots].set(k)
+        vcl = vcl.at[slots].set(v)
+        attn = causal_attention(q, k, v, seq_lens)
+        delta = jnp.einsum("bte,ed->btd", attn.reshape(B, T, -1), lp["wo"])
+        x = x + lax.psum(delta, AXIS_TENSOR)
+        h2 = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _tp_mlp(lp, h2)
+        return x, (kcl, vcl)
+
+    x, (kc, vc) = lax.scan(body, x, (layers, kc, vc))
+    return x, kc, vc
+
+
+def _stage_decode(cfg, layers, x, pos, write_slots, kc, vc, pt, seq_lens, ps):
+    """One decode step through this stage's local layers.
+
+    x: [mb, 1, D]; kc/vc: [Lp, S, Hk_loc, hd]; write_slots: [mb]
+    (trash-redirected on bubbles); pt: [mb, max_pages]; seq_lens: [mb].
+    """
+    mb = x.shape[0]
+    pos2 = pos[:, None]
+
+    def body(carry, per_layer):
+        x = carry
+        lp, kcl, vcl = per_layer
+        h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q, k, v = _tp_qkv(cfg, lp, h)
+        q = apply_rope(q, pos2, cfg.rope_theta)
+        k = apply_rope(k, pos2, cfg.rope_theta)
+        kcl = kcl.at[write_slots].set(k[:, 0])
+        vcl = vcl.at[write_slots].set(v[:, 0])
+        attn = paged_decode_attention(q[:, 0], kcl, vcl, pt, seq_lens, ps)
+        delta = jnp.einsum("be,ed->bd", attn.reshape(mb, -1), lp["wo"])
+        x = x + lax.psum(delta, AXIS_TENSOR)[:, None, :]
+        h2 = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        x = x + _tp_mlp(lp, h2)
+        return x, (kcl, vcl)
+
+    x, (kc, vc) = lax.scan(body, x, (layers, kc, vc))
+    return x, kc, vc
+
+
+# ---------------------------------------------------------------------------
+# Vocab-sharded embedding / logits under shard_map.
+# ---------------------------------------------------------------------------
+
+
+def _embed_lookup(embed_local: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Gather from a vocab-sharded embedding: each tensor shard looks up
+    the ids it owns, everything else contributes zero, psum combines."""
+    ti = lax.axis_index(AXIS_TENSOR)
+    v_loc = embed_local.shape[0]
+    loc = tokens - ti * v_loc
+    ok = (loc >= 0) & (loc < v_loc)
+    x = embed_local[jnp.clip(loc, 0, v_loc - 1)]
+    x = jnp.where(ok[..., None], x, jnp.zeros((), embed_local.dtype))
+    return lax.psum(x, AXIS_TENSOR)
+
+
+def _final_logits(params: dict, cfg: ModelConfig, x_last: jnp.ndarray) -> jnp.ndarray:
+    """x_last: [B, D] last-position hiddens (zero on every stage but the
+    last). Returns replicated [B, V]: psum over pipe folds the stages
+    (zeros elsewhere), all_gather over tensor stitches the vocab shards."""
+    xf = rmsnorm(x_last, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head", params["embed"])
+    logits = jnp.einsum(
+        "bd,vd->bv", xf.astype(jnp.float32), head.astype(jnp.float32)
+    )
+    logits = lax.psum(logits, AXIS_PIPE)
+    return lax.all_gather(logits, AXIS_TENSOR, axis=1, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# Pipelined forwards (drop-in signatures vs the llama.py single-mesh ones).
+# ---------------------------------------------------------------------------
+
+
+def pp_forward_prefill(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, T] right-padded
+    seq_lens: jnp.ndarray,  # [B]
+    k_cache: jnp.ndarray,  # [L, S, Hk, hd], L sharded over "pipe"
+    v_cache: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, max_pages]
+    page_size: int,
+    mesh: Mesh,
+    n_micro: Optional[int] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Pipelined prefill; returns (last_logits [B, V], k_cache', v_cache').
+    Exact vs forward_prefill — schedule-only difference."""
+    B, T = tokens.shape
+    pipe = mesh.shape[AXIS_PIPE]
+    M = n_microbatches(B, pipe, n_micro)
+    mb = B // M
+    kv_spec = P(AXIS_PIPE, None, AXIS_TENSOR, None)
+
+    def body(params, tokens, seq_lens, kc, vc, pt):
+        p = lax.axis_index(AXIS_PIPE)
+        x = _embed_lookup(params["embed"], tokens)  # [B, T, D]
+        x_all = x.reshape(M, mb, T, -1)
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (mb, T))
+        pos_b = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+        slots_all = flat_slot_indices(pt, pos_b, page_size).reshape(M, mb, T)
+        lens_all = seq_lens.reshape(M, mb)
+        out_x = jnp.zeros((M, mb, x.shape[-1]), x.dtype)
+        h0 = jnp.zeros((mb, T, x.shape[-1]), x.dtype)
+
+        def step(t, carry):
+            h_state, kc, vc, out_x = carry
+            m = jnp.clip(t - p, 0, M - 1)
+            valid = (t >= p) & (t - p < M)
+            inp = jnp.where(
+                p == 0,
+                lax.dynamic_index_in_dim(x_all, m, 0, keepdims=False),
+                h_state,
+            )
+            lens = lax.dynamic_index_in_dim(lens_all, m, 0, keepdims=False)
+            slots = lax.dynamic_index_in_dim(slots_all, m, 0, keepdims=False)
+            slots = jnp.where(valid, slots, 0)  # bubbles write to trash
+            h_out, kc, vc = _stage_prefill(
+                cfg, params["layers"], inp, positions, lens, kc, vc, slots
+            )
+            last = jnp.clip(lens - 1, 0, T - 1)
+            x_last = jnp.take_along_axis(h_out, last[:, None, None], axis=1)[:, 0]
+            prev = lax.dynamic_index_in_dim(out_x, m, 0, keepdims=False)
+            row = jnp.where(valid & (p == pipe - 1), x_last, prev)
+            out_x = lax.dynamic_update_index_in_dim(out_x, row, m, 0)
+            perm = [(d, (d + 1) % pipe) for d in range(pipe)]
+            h_nxt = lax.ppermute(h_out, AXIS_PIPE, perm)
+            return h_nxt, kc, vc, out_x
+
+        _, kc, vc, out_x = lax.fori_loop(
+            0, M + pipe - 1, step, (h0, kc, vc, out_x)
+        )
+        logits = _final_logits(params, cfg, out_x.reshape(B, -1))
+        return logits, kc, vc
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(pipeline_param_specs(params), P(), P(), kv_spec, kv_spec, P()),
+        out_specs=(P(), kv_spec, kv_spec),
+        check_vma=False,
+    )(params, tokens, seq_lens, k_cache, v_cache, page_table)
+
+
+def pp_forward_decode(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B] last generated token per slot
+    positions: jnp.ndarray,  # [B]
+    k_cache: jnp.ndarray,  # [L, S, Hk, hd], L sharded over "pipe"
+    v_cache: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, max_pages]
+    page_size: int,
+    mesh: Mesh,
+    n_micro: Optional[int] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Pipelined single decode step; returns (logits [B, V], caches')."""
+    B = tokens.shape[0]
+    pipe = mesh.shape[AXIS_PIPE]
+    M = n_microbatches(B, pipe, n_micro)
+    mb = B // M
+    kv_spec = P(AXIS_PIPE, None, AXIS_TENSOR, None)
+
+    def body(params, tokens, positions, kc, vc, pt):
+        p = lax.axis_index(AXIS_PIPE)
+        x = _embed_lookup(params["embed"], tokens)  # [B, D]
+        x_all = x.reshape(M, mb, 1, -1)
+        ws_all = flat_slot_indices(pt, positions[:, None], page_size)[:, 0]
+        ws_all = ws_all.reshape(M, mb)
+        pos_all = positions.reshape(M, mb)
+        pt_all = pt.reshape(M, mb, -1)
+        lens_all = pos_all + 1
+        out_x = jnp.zeros((M, mb, x.shape[-1]), x.dtype)
+        h0 = jnp.zeros((mb, 1, x.shape[-1]), x.dtype)
+
+        def step(t, carry):
+            h_state, kc, vc, out_x = carry
+            m = jnp.clip(t - p, 0, M - 1)
+            valid = (t >= p) & (t - p < M)
+            inp = jnp.where(
+                p == 0,
+                lax.dynamic_index_in_dim(x_all, m, 0, keepdims=False),
+                h_state,
+            )
+            pos = lax.dynamic_index_in_dim(pos_all, m, 0, keepdims=False)
+            lens = lax.dynamic_index_in_dim(lens_all, m, 0, keepdims=False)
+            ptm = lax.dynamic_index_in_dim(pt_all, m, 0, keepdims=False)
+            ws = lax.dynamic_index_in_dim(ws_all, m, 0, keepdims=False)
+            ws = jnp.where(valid, ws, 0)  # bubbles write to trash
+            h_out, kc, vc = _stage_decode(
+                cfg, params["layers"], inp, pos, ws, kc, vc, ptm, lens,
+                page_size,
+            )
+            prev = lax.dynamic_index_in_dim(out_x, m, 0, keepdims=False)
+            row = jnp.where(valid & (p == pipe - 1), h_out[:, 0], prev)
+            out_x = lax.dynamic_update_index_in_dim(out_x, row, m, 0)
+            perm = [(d, (d + 1) % pipe) for d in range(pipe)]
+            h_nxt = lax.ppermute(h_out, AXIS_PIPE, perm)
+            return h_nxt, kc, vc, out_x
+
+        _, kc, vc, out_x = lax.fori_loop(
+            0, M + pipe - 1, step, (h0, kc, vc, out_x)
+        )
+        logits = _final_logits(params, cfg, out_x.reshape(B, -1))
+        return logits, kc, vc
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(pipeline_param_specs(params), P(), P(), kv_spec, kv_spec, P()),
+        out_specs=(P(), kv_spec, kv_spec),
+        check_vma=False,
+    )(params, tokens, positions, k_cache, v_cache, page_table)

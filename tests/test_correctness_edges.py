@@ -12,6 +12,7 @@
 """
 
 import time
+from types import SimpleNamespace
 
 import jax.numpy as jnp
 import pytest
@@ -92,7 +93,7 @@ class _StubReplica:
         self.chunking = []
         self.submitted = []
 
-    def has_capacity(self):
+    def has_capacity(self, kind=None):
         return self._capacity
 
     def active_count(self):
@@ -105,7 +106,7 @@ class _StubReplica:
 
 def test_replicaset_submit_refuses_when_full():
     rs = ReplicaSet([_StubReplica(False, 1), _StubReplica(False, 0)])
-    assert rs.submit(object()) is False
+    assert rs.submit(SimpleNamespace(kind="generate")) is False
     assert all(not r.submitted for r in rs.replicas)
 
 

@@ -85,33 +85,38 @@ def test_least_loaded_placement_and_rotation():
             self.submitted = []
             self.capacity = True
 
-        def has_capacity(self):
+        def has_capacity(self, kind=None):
             return self.capacity
 
         def active_count(self):
             return len(self.submitted)
 
         def submit(self, req):
-            self.submitted.append(req)
+            self.submitted.append(req.name)
 
         name = "fake"
         cfg = ecfg = None
+
+    def _req(name):
+        from types import SimpleNamespace
+
+        return SimpleNamespace(name=name, kind="generate")
 
     a, b, c = FakeReplica(), FakeReplica(), FakeReplica()
     rs = ReplicaSet.__new__(ReplicaSet)
     rs.replicas = [a, b, c]
     rs._last_idx = 0
     # All empty: rotation starts after index 0 => b, then ties rotate c, a.
-    rs.submit("r1")
+    rs.submit(_req("r1"))
     assert b.submitted == ["r1"]
-    rs.submit("r2")
+    rs.submit(_req("r2"))
     assert c.submitted == ["r2"]
-    rs.submit("r3")
+    rs.submit(_req("r3"))
     assert a.submitted == ["r3"]
     # Load-based: make b busiest, c without capacity => a wins.
     b.submitted += ["x", "y"]
     c.capacity = False
-    rs.submit("r4")
+    rs.submit(_req("r4"))
     assert a.submitted == ["r3", "r4"]
 
 

@@ -255,7 +255,7 @@ def test_embed_admitted_while_decode_saturated():
                                   sampling=SamplingParams(), kind="embed")
         items = collect(emb, timeout=60)
         assert items[-1].kind == "done" and emb.embedding is not None
-        assert not gen.finished.is_set(), \
+        assert gen.stats.finished_at == 0.0, \
             "generation finished first: embed waited on a decode slot"
         gen.cancelled.set()
     finally:
