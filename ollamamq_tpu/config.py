@@ -33,6 +33,15 @@ class ModelConfig:
     attn_bias: bool = False
     # Bidirectional attention + mean pooling => embedding encoder, not a LM.
     is_encoder: bool = False
+    # Mixture-of-experts (Mixtral family): 0 = dense FFN. When > 0, each
+    # layer's FFN becomes num_experts independent SwiGLU experts with
+    # top-(num_experts_per_tok) routing (models/moe.py); experts shard
+    # over the mesh "expert" axis.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    # Static per-expert token capacity = ceil(tokens * k / E) * factor;
+    # overflow tokens fall through to the residual (their FFN delta is 0).
+    moe_capacity_factor: float = 2.0
 
     @property
     def q_dim(self) -> int:
@@ -45,9 +54,12 @@ class ModelConfig:
     def param_count(self) -> int:
         """Approximate parameter count (for HBM budgeting)."""
         d, f, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        mlp = 3 * d * f
+        if self.num_experts:
+            mlp = self.num_experts * 3 * d * f + d * self.num_experts
         per_layer = (
             d * self.q_dim + 2 * d * self.kv_dim + self.q_dim * d  # attn
-            + 3 * d * f  # swiglu mlp
+            + mlp
             + 2 * d  # norms
         )
         embed = v * d * (1 if self.tie_embeddings else 2)
@@ -120,6 +132,19 @@ MODEL_CONFIGS = {
         head_dim=16, rope_theta=1000.0, max_seq_len=512, tie_embeddings=True,
         is_encoder=True,
     ),
+    # Mixture-of-experts family (Mixtral 8x7b architecture description).
+    "mixtral:8x7b": ModelConfig(
+        name="mixtral:8x7b", vocab_size=32_000, hidden_size=4096,
+        intermediate_size=14_336, num_layers=32, num_heads=32,
+        num_kv_heads=8, head_dim=128, rope_theta=1_000_000.0,
+        max_seq_len=32_768, num_experts=8, num_experts_per_tok=2,
+    ),
+    "test-tiny-moe": ModelConfig(
+        name="test-tiny-moe", vocab_size=512, hidden_size=64,
+        intermediate_size=96, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, rope_theta=10_000.0, max_seq_len=512,
+        num_experts=4, num_experts_per_tok=2,
+    ),
 }
 
 
@@ -184,11 +209,13 @@ class EngineConfig:
     # (llama.cpp repeat_last_n; engine-wide static).
     repeat_last_n: int = 64
     # Mesh axis sizes; tp=-1 means "all remaining devices". The engine
-    # builds its (data, seq, tensor) mesh from these unless an explicit
-    # mesh object is passed to TPUEngine.
+    # builds its (data, pipe, seq, expert, tensor) mesh from these unless
+    # an explicit mesh object is passed to TPUEngine.
     dp: int = 1
     sp: int = 1
     tp: int = 1
+    pp: int = 1
+    ep: int = 1
     dtype: str = "bfloat16"
     seed: int = 0
 

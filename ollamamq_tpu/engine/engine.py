@@ -47,6 +47,7 @@ from ollamamq_tpu.engine.tokenizer import load_tokenizer
 from ollamamq_tpu.models import llama, weights
 from ollamamq_tpu.ops.sampling import (maybe_apply_penalties, per_row_keys,
                                        sample_tokens_rowwise, sampling_flags)
+from ollamamq_tpu.parallel import pipeline
 from ollamamq_tpu.parallel.mesh import (make_mesh, replica_submesh,
                                         validate_tp_for_model)
 from ollamamq_tpu.parallel.sharding import kv_cache_spec, shard_params
@@ -205,6 +206,37 @@ class ModelRuntime:
             validate_tp_for_model(
                 mesh.shape["tensor"], model_cfg.num_kv_heads, model_cfg.num_heads
             )
+        # Pipeline parallelism: layers (weights + KV pages) split over the
+        # mesh "pipe" axis; forwards swap to the shard_map'd GPipe schedule
+        # (parallel/pipeline.py).
+        self._pp = dict(mesh.shape).get("pipe", 1) if mesh is not None else 1
+        if self._pp > 1:
+            if model_cfg.num_layers % self._pp != 0:
+                raise ValueError(
+                    f"pp={self._pp} must divide num_layers="
+                    f"{model_cfg.num_layers} ({name})")
+            if dict(mesh.shape).get("seq", 1) > 1:
+                raise ValueError(
+                    "pp and sp cannot combine on one runtime: pipeline "
+                    "stages and sequence shards contend for the same "
+                    "activation layout (use pp x tp, or sp x tp)")
+            if model_cfg.num_experts:
+                raise ValueError(
+                    "pp with an MoE model is not supported: the pipeline "
+                    "stage body runs the dense FFN (use ep x tp for MoE)")
+            # forward_embed is a plain GSPMD scan: over pipe-sharded layer
+            # stacks XLA would all-gather every stage's weights into each
+            # group — an OOM on exactly the >HBM models pp exists for.
+            # Serve generate only; embeds get the kind-gate's clean error.
+            self.SERVES = ("generate",)
+            log.info("%s: pp=%d runtime serves generate only "
+                     "(embed needs pipe-replicated layers)", name, self._pp)
+        ep = dict(mesh.shape).get("expert", 1) if mesh is not None else 1
+        if ep > 1 and (model_cfg.num_experts == 0
+                       or model_cfg.num_experts % ep != 0):
+            raise ValueError(
+                f"ep={ep} needs an MoE model with num_experts divisible by "
+                f"it ({name} has {model_cfg.num_experts})")
         # `preloaded_params`: host-side tree shared across dp replicas so a
         # checkpoint is read/parsed once, not once per replica; each replica
         # still device_puts its own copy via shard_params below.
@@ -230,8 +262,8 @@ class ModelRuntime:
         if mesh is not None:
             from jax.sharding import NamedSharding
 
-            params = shard_params(params, mesh)
-            kv_sharding = NamedSharding(mesh, kv_cache_spec())
+            params = shard_params(params, mesh, pp=self._pp > 1)
+            kv_sharding = NamedSharding(mesh, kv_cache_spec(pp=self._pp > 1))
         self.params = params
         self.kc, self.vc = kvc.alloc_kv_pool(model_cfg, engine_cfg, kv_sharding, dtype)
         # Repeat-penalty state: ring of each slot's last-W context token ids
@@ -292,6 +324,14 @@ class ModelRuntime:
             if jax.default_backend() == "tpu" and not no_pallas
             else "jnp"
         )
+        if self._pp > 1 and self.attn_impl == "pallas":
+            # The pipelined decode stage (parallel/pipeline.py) runs the
+            # jnp paged attention — the Pallas kernel is unproven inside
+            # shard_map. Say so rather than silently serving slower.
+            log.warning(
+                "%s: pp=%d decode uses the jnp paged attention, not the "
+                "Pallas kernel", name, self._pp)
+            self.attn_impl = "jnp"
         # Flips true after the first successful decode dispatch; until then
         # a pallas failure falls back to jnp instead of failing the runtime.
         self._pallas_proven = False
@@ -410,12 +450,18 @@ class ModelRuntime:
         if key_ not in self._prefill_jits:
             cfg, ps = self.cfg, self.ecfg.page_size
             need_pen, need_mask, need_sample = flags
+            pp, mesh = self._pp, self.mesh
 
             def fn(params, tokens, seq_lens, kc, vc, recent, slot_ids, pt,
                    temp, tk, tp, pen, pres, freq, seeds, key):
-                logits, kc, vc = llama.forward_prefill(
-                    params, cfg, tokens, seq_lens, kc, vc, pt, ps
-                )
+                if pp > 1:
+                    logits, kc, vc = pipeline.pp_forward_prefill(
+                        params, cfg, tokens, seq_lens, kc, vc, pt, ps, mesh
+                    )
+                else:
+                    logits, kc, vc = llama.forward_prefill(
+                        params, cfg, tokens, seq_lens, kc, vc, pt, ps
+                    )
                 B, T = tokens.shape
                 W = recent.shape[1]
                 # Ring rows = the last W prompt tokens of each sequence.
@@ -443,12 +489,19 @@ class ModelRuntime:
         if ("chunk", chunk, flags) not in self._prefill_jits:
             cfg, ps = self.cfg, self.ecfg.page_size
             need_pen, need_mask, need_sample = flags
+            pp, mesh = self._pp, self.mesh
 
             def fn(params, tokens, start, chunk_lens, kc, vc, recent, slot_id,
                    is_final, pt, temp, tk, tp, pen, pres, freq, seeds, key):
-                logits, kc, vc = llama.forward_prefill_chunk(
-                    params, cfg, tokens, start, chunk_lens, kc, vc, pt, ps
-                )
+                if pp > 1:
+                    logits, kc, vc = pipeline.pp_forward_prefill_chunk(
+                        params, cfg, tokens, start, chunk_lens, kc, vc, pt,
+                        ps, mesh
+                    )
+                else:
+                    logits, kc, vc = llama.forward_prefill_chunk(
+                        params, cfg, tokens, start, chunk_lens, kc, vc, pt, ps
+                    )
                 C = tokens.shape[1]
                 W = recent.shape[1]
                 row = recent[slot_id[0]]  # [W]
@@ -583,6 +636,7 @@ class ModelRuntime:
             cfg, ps = self.cfg, self.ecfg.page_size
             attn_impl = self.attn_impl
             need_pen, need_mask, need_sample = flags
+            pp, mesh = self._pp, self.mesh
 
             def fn(params, tokens, positions, kc, vc, recent, active, pt,
                    temp, tk, tp, pen, pres, freq, seeds, key):
@@ -590,10 +644,16 @@ class ModelRuntime:
 
                 def step(carry, _):
                     tokens, positions, kc, vc, recent, key = carry
-                    logits, kc, vc = llama.forward_decode(
-                        params, cfg, tokens, positions, kc, vc, pt, ps,
-                        attn_impl=attn_impl,
-                    )
+                    if pp > 1:
+                        logits, kc, vc = pipeline.pp_forward_decode(
+                            params, cfg, tokens, positions, kc, vc, pt, ps,
+                            mesh
+                        )
+                    else:
+                        logits, kc, vc = llama.forward_decode(
+                            params, cfg, tokens, positions, kc, vc, pt, ps,
+                            attn_impl=attn_impl, active=active,
+                        )
                     key, sub = jax.random.split(key)
                     pen_logits = maybe_apply_penalties(logits, recent[:S],
                                                        pen, pres, freq,
@@ -1350,8 +1410,11 @@ class TPUEngine:
         self.ecfg = engine_cfg
         self.core = MQCore(blocklist_path)
         self.core.set_fairness(fairness)
-        if mesh is None and (engine_cfg.dp, engine_cfg.sp, engine_cfg.tp) != (1, 1, 1):
-            mesh = make_mesh(dp=engine_cfg.dp, sp=engine_cfg.sp, tp=engine_cfg.tp)
+        if mesh is None and (engine_cfg.dp, engine_cfg.sp, engine_cfg.tp,
+                             engine_cfg.pp, engine_cfg.ep) != (1, 1, 1, 1, 1):
+            mesh = make_mesh(dp=engine_cfg.dp, sp=engine_cfg.sp,
+                             tp=engine_cfg.tp, pp=engine_cfg.pp,
+                             ep=engine_cfg.ep)
         self.mesh = mesh
         self.dtype = dtype if dtype is not None else jnp.dtype(engine_cfg.dtype)
         self.runtimes: Dict[str, object] = {}

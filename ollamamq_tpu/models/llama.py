@@ -63,6 +63,13 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
         layers["bq"] = jnp.zeros((L, qd), dtype)
         layers["bk"] = jnp.zeros((L, kvd), dtype)
         layers["bv"] = jnp.zeros((L, kvd), dtype)
+    if cfg.num_experts:
+        # MoE family: the dense FFN is replaced by routed experts.
+        from ollamamq_tpu.models.moe import init_moe_layer_params
+
+        for dense in ("w_gate", "w_up", "w_down"):
+            del layers[dense]
+        layers.update(init_moe_layer_params(cfg, keys[9], dtype))
     params = {
         "embed": w(keys[7], (v, d), d),
         "final_norm": jnp.ones((d,), dtype),
@@ -95,6 +102,20 @@ def _mlp(lp: dict, h: jnp.ndarray) -> jnp.ndarray:
     return jnp.einsum("btf,fd->btd", jax.nn.silu(gate) * up, lp["w_down"])
 
 
+def _ffn(cfg: ModelConfig, lp: dict, h: jnp.ndarray,
+         valid=None) -> jnp.ndarray:
+    """Dense SwiGLU or routed mixture-of-experts, by model family.
+
+    `valid` ([B, T] bool) marks real tokens; only MoE routing consumes it
+    (padding/inactive rows must not claim expert capacity).
+    """
+    if cfg.num_experts:
+        from ollamamq_tpu.models.moe import moe_mlp
+
+        return moe_mlp(cfg, lp, h, valid=valid)
+    return _mlp(lp, h)
+
+
 def _logits(params: dict, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params.get("lm_head", params["embed"])
@@ -102,7 +123,7 @@ def _logits(params: dict, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _layer_step(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
-                positions: jnp.ndarray, attn_fn):
+                positions: jnp.ndarray, attn_fn, valid=None):
     """One transformer layer over a full [B, T, D] sequence.
 
     The SINGLE definition of the layer math for every full-sequence
@@ -120,7 +141,7 @@ def _layer_step(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
     attn = attn_fn(q, k, v)
     x = x + jnp.einsum("bte,ed->btd", attn.reshape(B, T, cfg.q_dim), lp["wo"])
     h2 = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-    return x + _mlp(lp, h2), k, v
+    return x + _ffn(cfg, lp, h2, valid=valid), k, v
 
 
 def forward_prefill(
@@ -149,6 +170,7 @@ def forward_prefill(
         x, k, v = _layer_step(
             cfg, lp, x, positions,
             lambda q, k, v: causal_attention(q, k, v, seq_lens),
+            valid=positions < seq_lens[:, None],
         )
         kc = kc.at[slots].set(k)
         vc = vc.at[slots].set(v)
@@ -201,7 +223,10 @@ def forward_prefill_chunk(
                 q, kc, vc, page_table, start, chunk_lens, page_size
             )
 
-        x, _, _ = _layer_step(cfg, lp, x, positions, attn_fn)
+        x, _, _ = _layer_step(
+            cfg, lp, x, positions, attn_fn,
+            valid=jnp.arange(tokens.shape[1])[None, :] < chunk_lens[:, None],
+        )
         return x, (kc, vc)
 
     x, (k_cache, v_cache) = jax.lax.scan(
@@ -223,9 +248,15 @@ def forward_decode(
     page_table: jnp.ndarray,  # [B, max_pages]
     page_size: int,
     attn_impl: str = "jnp",  # "jnp" reference | "pallas" ragged TPU kernel
+    active=None,  # [B] int32/bool — live decode slots (None = all live)
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """One decode step for the whole batch; returns (logits [B,V], caches')."""
+    """One decode step for the whole batch; returns (logits [B,V], caches').
+
+    `active` feeds MoE routing only: parked slots carry garbage tokens
+    that must not claim expert capacity (models/moe.py).
+    """
     B = tokens.shape[0]
+    valid = None if active is None else (active > 0)[:, None]
     x = params["embed"][tokens].astype(params["embed"].dtype)[:, None, :]  # [B,1,D]
     pos2 = positions[:, None]  # [B,1]
     write_slots = flat_slot_indices(page_table, pos2, page_size)[:, 0]  # [B]
@@ -254,7 +285,7 @@ def forward_decode(
             )  # [B,H,hd]
         x = x + jnp.einsum("be,ed->bd", attn.reshape(B, cfg.q_dim), lp["wo"])[:, None, :]
         h2 = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(lp, h2)
+        x = x + _ffn(cfg, lp, h2, valid=valid)
         return x, (kc, vc)
 
     x, (k_cache, v_cache) = jax.lax.scan(
@@ -294,6 +325,7 @@ def forward_prefill_sp(
         x, k, v = _layer_step(
             cfg, lp, x, positions,
             lambda q, k, v: ring_attention(q, k, v, seq_lens, mesh),
+            valid=positions < seq_lens[:, None],
         )
         x = jax.lax.with_sharding_constraint(x, seq_sharded)
         return x, (k, v)
@@ -325,6 +357,7 @@ def forward_embed(
         x, _, _ = _layer_step(
             cfg, lp, x, positions,
             lambda q, k, v: causal_attention(q, k, v, seq_lens),
+            valid=positions < seq_lens[:, None],
         )
         return x, None
 
@@ -351,6 +384,7 @@ def forward_encoder(
         x, _, _ = _layer_step(
             cfg, lp, x, positions,
             lambda q, k, v: bidirectional_attention(q, k, v, seq_lens),
+            valid=positions < seq_lens[:, None],
         )
         return x, None
 

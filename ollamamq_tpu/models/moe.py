@@ -1,0 +1,113 @@
+"""Mixture-of-experts FFN (Mixtral family) with expert parallelism.
+
+The reference serves MoE models only by proxying to an Ollama backend that
+happens to run one (llama.cpp does the routing on CPU/GPU); it has no
+expert-parallel story at all. Here MoE is a first-class layer family:
+
+  - Routing is token-choice top-k (Mixtral semantics: softmax over all
+    experts, take top-k, renormalize the kept probabilities).
+  - Dispatch/combine use the GShard dense formulation — one-hot
+    position-in-expert tensors contracted with einsum — because that is
+    the shape-static, compiler-friendly layout: no gather/scatter with
+    data-dependent sizes, everything tiles onto the MXU, and XLA's SPMD
+    partitioner turns the [E, C, D] dispatch einsum into the expert
+    all-to-all when `we_*` are sharded over the mesh "expert" axis.
+  - Per-expert capacity C = ceil(N*k/E * capacity_factor) is STATIC.
+    Tokens routed past an expert's capacity contribute nothing for that
+    expert slot (their combine weight is zero) and fall through to the
+    residual stream — the standard token-dropping trade, bounded by the
+    capacity factor (config.moe_capacity_factor, default 2.0).
+
+Expert weights are stacked [L, E, ...] so the layer scan carries them like
+every other layer param; the "expert" dim shards over AXIS_EXPERT and the
+per-expert FFN dim over AXIS_TENSOR (parallel/sharding.py), composing
+EP x TP without any code change here — GSPMD propagates from the weight
+shardings.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ollamamq_tpu.config import ModelConfig
+
+
+def init_moe_layer_params(cfg: ModelConfig, key: jax.Array, dtype) -> dict:
+    """Router + stacked expert weights for every layer: contributes the
+    FFN entries of the `layers` tree when cfg.num_experts > 0."""
+    d, f = cfg.hidden_size, cfg.intermediate_size
+    L, E = cfg.num_layers, cfg.num_experts
+    keys = jax.random.split(key, 4)
+
+    def w(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32)
+                / jnp.sqrt(fan_in)).astype(dtype)
+
+    return {
+        "w_router": w(keys[0], (L, d, E), d),
+        "we_gate": w(keys[1], (L, E, d, f), d),
+        "we_up": w(keys[2], (L, E, d, f), d),
+        "we_down": w(keys[3], (L, E, f, d), f),
+    }
+
+
+def expert_capacity(n_tokens: int, cfg: ModelConfig) -> int:
+    """Static per-expert token capacity for a batch of n_tokens."""
+    ideal = n_tokens * cfg.num_experts_per_tok / cfg.num_experts
+    return max(1, int(math.ceil(ideal * cfg.moe_capacity_factor)))
+
+
+def moe_mlp(cfg: ModelConfig, lp: dict, h: jnp.ndarray,
+            valid=None) -> jnp.ndarray:
+    """Top-k routed expert FFN over [B, T, D] hiddens; returns [B, T, D].
+
+    Same contract as llama._mlp (the residual add happens in the caller).
+    `valid` ([B, T] bool, optional) marks real tokens: padding positions
+    and inactive decode slots must not CLAIM expert capacity, or identical
+    garbage rows (all routing alike) crowd real tokens out of their
+    experts' queues and silently zero their FFN delta.
+    """
+    B, T, D = h.shape
+    E, K = cfg.num_experts, cfg.num_experts_per_tok
+    N = B * T
+    C = expert_capacity(N, cfg)
+    x = h.reshape(N, D)
+
+    # Router in f32: the softmax is over a handful of experts and feeds
+    # multiplicative gates — bf16 here costs real quality for no speed.
+    logits = jnp.einsum(
+        "nd,de->ne", x.astype(jnp.float32), lp["w_router"].astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(logits, axis=-1)  # [N, E]
+    gate_vals, expert_idx = jax.lax.top_k(probs, K)  # [N, K]
+    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+
+    # Position of each (token, k-slot) in its expert's queue, token-major
+    # (GShard "first C win"). sel: [N, K, E] one-hot on the routed expert;
+    # invalid tokens select nothing (and so consume no capacity).
+    sel = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)  # [N, K, E]
+    if valid is not None:
+        sel = sel * valid.reshape(N).astype(jnp.int32)[:, None, None]
+    pos = jnp.cumsum(sel.reshape(N * K, E), axis=0).reshape(N, K, E) - sel
+    keep = (pos < C) & (sel > 0)  # [N, K, E]
+
+    # One-hot (token, k-slot) -> (expert, capacity-slot); dropped and
+    # unrouted entries point at index C, whose one-hot row is all zeros.
+    pos_oh = jax.nn.one_hot(jnp.where(keep, pos, C), C, dtype=h.dtype)
+    dispatch = jnp.sum(pos_oh, axis=1)  # [N, E, C] 0/1 (k-slots disjoint)
+    combine = jnp.einsum(
+        "nkec,nk->nec", pos_oh, gate_vals.astype(h.dtype)
+    )  # [N, E, C] gate weights
+
+    # Expert compute on the dispatched [E, C, D] blocks — the einsums XLA
+    # partitions over "expert"/"tensor" when we_* carry those shardings.
+    xe = jnp.einsum("nec,nd->ecd", dispatch, x)
+    gate = jnp.einsum("ecd,edf->ecf", xe, lp["we_gate"])
+    up = jnp.einsum("ecd,edf->ecf", xe, lp["we_up"])
+    out_e = jnp.einsum("ecf,efd->ecd", jax.nn.silu(gate) * up, lp["we_down"])
+
+    y = jnp.einsum("nec,ecd->nd", combine, out_e)  # gates applied here
+    return y.reshape(B, T, D)

@@ -83,6 +83,26 @@ def load_safetensors(cfg: ModelConfig, path: str, dtype=jnp.bfloat16) -> dict:
         )
         layers[ours] = jnp.asarray(stack, dtype=dtype)
 
+    if cfg.num_experts:
+        # Mixtral layout: block_sparse_moe.gate + experts.N.w1/w3/w2
+        # (gate/up/down). Stack experts on axis 1 -> [L, E, D, F] etc.
+        def estack(w_name: str, transpose: bool):
+            return jnp.asarray(np.stack([
+                np.stack([
+                    grab(f"model.layers.{i}.block_sparse_moe.experts."
+                         f"{e}.{w_name}.weight", transpose)
+                    for e in range(cfg.num_experts)
+                ]) for i in range(cfg.num_layers)
+            ]), dtype=dtype)
+
+        layers["w_router"] = jnp.asarray(np.stack([
+            grab(f"model.layers.{i}.block_sparse_moe.gate.weight", True)
+            for i in range(cfg.num_layers)
+        ]), dtype=dtype)
+        layers["we_gate"] = estack("w1", True)
+        layers["we_down"] = estack("w2", True)
+        layers["we_up"] = estack("w3", True)
+
     params = {
         "embed": jnp.asarray(grab("model.embed_tokens.weight", False), dtype=dtype),
         "final_norm": jnp.asarray(grab("model.norm.weight", False), dtype=dtype),

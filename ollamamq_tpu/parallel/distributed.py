@@ -72,11 +72,14 @@ def initialize(
     return True
 
 
-def global_mesh(dp: int = 1, sp: int = 1, tp: int = -1):
+def global_mesh(dp: int = 1, sp: int = 1, tp: int = -1, pp: int = 1,
+                ep: int = 1):
     """Mesh over ALL processes' devices. Axis order puts "tensor" innermost
     so TP collectives ride ICI within a host/slice and only the outer axes
-    ("data", "seq") cross DCN — the layout the scaling playbook prescribes."""
-    return make_mesh(dp=dp, sp=sp, tp=tp, devices=jax.devices())
+    ("data", "pipe", "seq") cross DCN — the layout the scaling playbook
+    prescribes."""
+    return make_mesh(dp=dp, sp=sp, tp=tp, pp=pp, ep=ep,
+                     devices=jax.devices())
 
 
 def is_primary() -> bool:

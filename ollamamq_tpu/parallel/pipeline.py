@@ -23,18 +23,21 @@ TPU-native schedule (not a translation of GPU send/recv pipelines):
   - Composes with tensor parallelism INSIDE each stage: head/FFN dims
     stay sharded over "tensor" and the row-parallel matmuls (wo, w_down)
     reduce via `lax.psum` — identity when tp == 1, Megatron-style TP
-    when tp > 1 (requires num_kv_heads % tp == 0; the replicated-group
-    KV trick is a non-PP path). Embedding and lm_head stay vocab-sharded
-    over "tensor" via masked local lookup + psum.
+    when tp > 1 (works with replicated-group KV too, since the shards'
+    local shapes carry the already-rewritten head counts). Embedding and
+    lm_head stay vocab-sharded over "tensor" via masked local lookup +
+    psum.
 
-Numerics match the single-device forwards exactly (same per-layer math,
-same f32 softmax); only the schedule is distributed — pinned by
-tests/test_pipeline.py against forward_prefill/forward_decode.
+All three serving forwards share one stage body (`_tp_layer`) and one
+schedule loop (`_pipeline_schedule`); they differ only in the attention
+call and the per-microbatch operands. Numerics match the single-device
+forwards exactly (same per-layer math, same f32 softmax); only the
+schedule is distributed — pinned by tests/test_pipeline.py against
+forward_prefill / forward_prefill_chunk / forward_decode.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Optional, Tuple
 
 import jax
@@ -47,28 +50,14 @@ from ollamamq_tpu.models.llama import rmsnorm
 from ollamamq_tpu.ops.attention import (
     causal_attention,
     flat_slot_indices,
+    paged_chunk_attention_blockwise,
     paged_decode_attention,
 )
 from ollamamq_tpu.ops.rope import apply_rope
 from ollamamq_tpu.parallel.mesh import AXIS_PIPE, AXIS_TENSOR
-from ollamamq_tpu.parallel.sharding import param_partition_specs
+from ollamamq_tpu.parallel.sharding import pipeline_param_specs
 
-
-def pipeline_param_specs(params: dict) -> dict:
-    """Partition specs for PP(xTP): the usual TP specs, plus every leaf of
-    the stacked `layers` subtree sharded over "pipe" on its leading
-    num_layers dim."""
-    specs = param_partition_specs(params)
-
-    def add_pipe(leaf, spec):
-        dims = list(spec) + [None] * (leaf.ndim - len(spec))
-        dims[0] = AXIS_PIPE
-        return P(*dims)
-
-    specs["layers"] = jax.tree_util.tree_map(
-        add_pipe, params["layers"], specs["layers"]
-    )
-    return specs
+KV_SPEC = P(AXIS_PIPE, None, AXIS_TENSOR, None)
 
 
 def n_microbatches(batch: int, pipe: int, requested: Optional[int] = None) -> int:
@@ -84,10 +73,10 @@ def n_microbatches(batch: int, pipe: int, requested: Optional[int] = None) -> in
 # ---------------------------------------------------------------------------
 # Per-stage layer math (tensor-parallel inside the stage).
 #
-# Mirrors models/llama.py:_layer_step / forward_decode's body, except the
-# head / FFN dims are tensor-LOCAL shards and the row-parallel outputs
-# (wo, w_down) reduce with an explicit psum — under shard_map the
-# collective XLA would otherwise infer from shardings must be written out.
+# Mirrors models/llama.py's layer bodies, except the head / FFN dims are
+# tensor-LOCAL shards and the row-parallel outputs (wo, w_down) reduce
+# with an explicit psum — under shard_map the collective XLA would
+# otherwise infer from shardings must be written out.
 # ---------------------------------------------------------------------------
 
 
@@ -112,57 +101,35 @@ def _tp_mlp(lp: dict, h: jnp.ndarray) -> jnp.ndarray:
     return lax.psum(down, AXIS_TENSOR)
 
 
-def _stage_prefill(cfg, layers, x, positions, seq_lens, kc, vc, slots):
-    """Run this stage's local layer stack over one microbatch.
+def _tp_layer(cfg, lp, x, positions, kcl, vcl, attn_and_cache):
+    """One transformer layer on this stage — the SINGLE definition of the
+    stage layer math (prefill, chunk, and decode inject only the
+    attention/KV-write schedule via `attn_and_cache`).
 
-    x: [mb, T, D]; kc/vc: [Lp, S, Hk_loc, hd] local cache slices;
-    slots: [mb, T] flat cache slots (trash-redirected on bubble steps).
+    x: [mb, T, D]; kcl/vcl: ONE local layer's [S, Hk_loc, hd] cache.
+    attn_and_cache(q, k, v, kcl, vcl) -> (attn [mb, T, H_loc*hd], kcl, vcl)
+    writes the new K/V wherever its schedule wants them, then attends.
     """
     B, T, _ = x.shape
+    h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    q, k, v = _tp_qkv(cfg, lp, h)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+    attn, kcl, vcl = attn_and_cache(q, k, v, kcl, vcl)
+    delta = jnp.einsum("bte,ed->btd", attn.reshape(B, T, -1), lp["wo"])
+    x = x + lax.psum(delta, AXIS_TENSOR)
+    h2 = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+    return x + _tp_mlp(lp, h2), kcl, vcl
+
+
+def _stage(cfg, layers, x, positions, kc, vc, attn_and_cache):
+    """Scan this stage's local layer stack over one microbatch."""
 
     def body(carry, per_layer):
         x = carry
         lp, kcl, vcl = per_layer
-        h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _tp_qkv(cfg, lp, h)
-        q = apply_rope(q, positions, cfg.rope_theta)
-        k = apply_rope(k, positions, cfg.rope_theta)
-        kcl = kcl.at[slots].set(k)
-        vcl = vcl.at[slots].set(v)
-        attn = causal_attention(q, k, v, seq_lens)
-        delta = jnp.einsum("bte,ed->btd", attn.reshape(B, T, -1), lp["wo"])
-        x = x + lax.psum(delta, AXIS_TENSOR)
-        h2 = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _tp_mlp(lp, h2)
-        return x, (kcl, vcl)
-
-    x, (kc, vc) = lax.scan(body, x, (layers, kc, vc))
-    return x, kc, vc
-
-
-def _stage_decode(cfg, layers, x, pos, write_slots, kc, vc, pt, seq_lens, ps):
-    """One decode step through this stage's local layers.
-
-    x: [mb, 1, D]; kc/vc: [Lp, S, Hk_loc, hd]; write_slots: [mb]
-    (trash-redirected on bubbles); pt: [mb, max_pages]; seq_lens: [mb].
-    """
-    mb = x.shape[0]
-    pos2 = pos[:, None]
-
-    def body(carry, per_layer):
-        x = carry
-        lp, kcl, vcl = per_layer
-        h = rmsnorm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = _tp_qkv(cfg, lp, h)
-        q = apply_rope(q, pos2, cfg.rope_theta)
-        k = apply_rope(k, pos2, cfg.rope_theta)
-        kcl = kcl.at[write_slots].set(k[:, 0])
-        vcl = vcl.at[write_slots].set(v[:, 0])
-        attn = paged_decode_attention(q[:, 0], kcl, vcl, pt, seq_lens, ps)
-        delta = jnp.einsum("be,ed->bd", attn.reshape(mb, -1), lp["wo"])
-        x = x + lax.psum(delta, AXIS_TENSOR)[:, None, :]
-        h2 = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        x = x + _tp_mlp(lp, h2)
+        x, kcl, vcl = _tp_layer(cfg, lp, x, positions, kcl, vcl,
+                                attn_and_cache)
         return x, (kcl, vcl)
 
     x, (kc, vc) = lax.scan(body, x, (layers, kc, vc))
@@ -200,6 +167,57 @@ def _final_logits(params: dict, cfg: ModelConfig, x_last: jnp.ndarray) -> jnp.nd
 
 
 # ---------------------------------------------------------------------------
+# The GPipe schedule, shared by all three forwards.
+# ---------------------------------------------------------------------------
+
+
+def _pipeline_schedule(pipe, M, x_all, kc, vc, run_stage):
+    """Drive M microbatches through `pipe` stages (M + pipe - 1 steps).
+
+    x_all: [M, mb, T, D] stage-0 inputs (embedded microbatches).
+    run_stage(m, valid, inp, kc, vc) -> (h_out [mb, T, D], kc, vc,
+    x_last [mb, D]) runs THIS stage's layers on microbatch m (`valid`
+    False on bubble steps — the callback must redirect its KV writes to
+    the trash page then). Returns (out_x [M, mb, D] last-stage results,
+    kc, vc).
+    """
+    p = lax.axis_index(AXIS_PIPE)
+    M_, mb = x_all.shape[0], x_all.shape[1]
+    out_x = jnp.zeros((M_, mb, x_all.shape[-1]), x_all.dtype)
+    h0 = jnp.zeros(x_all.shape[1:], x_all.dtype)
+
+    def step(t, carry):
+        h_state, kc, vc, out_x = carry
+        m = jnp.clip(t - p, 0, M - 1)
+        valid = (t >= p) & (t - p < M)
+        inp = jnp.where(
+            p == 0,
+            lax.dynamic_index_in_dim(x_all, m, 0, keepdims=False),
+            h_state,
+        )
+        h_out, kc, vc, x_last = run_stage(m, valid, inp, kc, vc)
+        prev = lax.dynamic_index_in_dim(out_x, m, 0, keepdims=False)
+        row = jnp.where(valid & (p == pipe - 1), x_last, prev)
+        out_x = lax.dynamic_update_index_in_dim(out_x, row, m, 0)
+        perm = [(d, (d + 1) % pipe) for d in range(pipe)]
+        h_nxt = lax.ppermute(h_out, AXIS_PIPE, perm)
+        return h_nxt, kc, vc, out_x
+
+    _, kc, vc, out_x = lax.fori_loop(0, M + pipe - 1, step, (h0, kc, vc, out_x))
+    return out_x, kc, vc
+
+
+def _pick(stack, m):
+    return lax.dynamic_index_in_dim(stack, m, 0, keepdims=False)
+
+
+def _last_valid(h_out, lens):
+    """[mb, T, D] -> [mb, D] at each row's last valid position."""
+    last = jnp.clip(lens - 1, 0, h_out.shape[1] - 1)
+    return jnp.take_along_axis(h_out, last[:, None, None], axis=1)[:, 0]
+
+
+# ---------------------------------------------------------------------------
 # Pipelined forwards (drop-in signatures vs the llama.py single-mesh ones).
 # ---------------------------------------------------------------------------
 
@@ -222,56 +240,101 @@ def pp_forward_prefill(
     pipe = mesh.shape[AXIS_PIPE]
     M = n_microbatches(B, pipe, n_micro)
     mb = B // M
-    kv_spec = P(AXIS_PIPE, None, AXIS_TENSOR, None)
 
     def body(params, tokens, seq_lens, kc, vc, pt):
-        p = lax.axis_index(AXIS_PIPE)
-        x = _embed_lookup(params["embed"], tokens)  # [B, T, D]
-        x_all = x.reshape(M, mb, T, -1)
+        x_all = _embed_lookup(params["embed"], tokens).reshape(M, mb, T, -1)
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (mb, T))
         pos_b = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
         slots_all = flat_slot_indices(pt, pos_b, page_size).reshape(M, mb, T)
         lens_all = seq_lens.reshape(M, mb)
-        out_x = jnp.zeros((M, mb, x.shape[-1]), x.dtype)
-        h0 = jnp.zeros((mb, T, x.shape[-1]), x.dtype)
 
-        def step(t, carry):
-            h_state, kc, vc, out_x = carry
-            m = jnp.clip(t - p, 0, M - 1)
-            valid = (t >= p) & (t - p < M)
-            inp = jnp.where(
-                p == 0,
-                lax.dynamic_index_in_dim(x_all, m, 0, keepdims=False),
-                h_state,
-            )
-            lens = lax.dynamic_index_in_dim(lens_all, m, 0, keepdims=False)
-            slots = lax.dynamic_index_in_dim(slots_all, m, 0, keepdims=False)
-            slots = jnp.where(valid, slots, 0)  # bubbles write to trash
-            h_out, kc, vc = _stage_prefill(
-                cfg, params["layers"], inp, positions, lens, kc, vc, slots
-            )
-            last = jnp.clip(lens - 1, 0, T - 1)
-            x_last = jnp.take_along_axis(h_out, last[:, None, None], axis=1)[:, 0]
-            prev = lax.dynamic_index_in_dim(out_x, m, 0, keepdims=False)
-            row = jnp.where(valid & (p == pipe - 1), x_last, prev)
-            out_x = lax.dynamic_update_index_in_dim(out_x, row, m, 0)
-            perm = [(d, (d + 1) % pipe) for d in range(pipe)]
-            h_nxt = lax.ppermute(h_out, AXIS_PIPE, perm)
-            return h_nxt, kc, vc, out_x
+        def run_stage(m, valid, inp, kc, vc):
+            lens = _pick(lens_all, m)
+            slots = jnp.where(valid, _pick(slots_all, m), 0)  # bubbles->trash
 
-        _, kc, vc, out_x = lax.fori_loop(
-            0, M + pipe - 1, step, (h0, kc, vc, out_x)
-        )
-        logits = _final_logits(params, cfg, out_x.reshape(B, -1))
-        return logits, kc, vc
+            def attn_and_cache(q, k, v, kcl, vcl):
+                kcl = kcl.at[slots].set(k)
+                vcl = vcl.at[slots].set(v)
+                return causal_attention(q, k, v, lens), kcl, vcl
+
+            h_out, kc, vc = _stage(cfg, params["layers"], inp, positions,
+                                   kc, vc, attn_and_cache)
+            return h_out, kc, vc, _last_valid(h_out, lens)
+
+        out_x, kc, vc = _pipeline_schedule(pipe, M, x_all, kc, vc, run_stage)
+        return _final_logits(params, cfg, out_x.reshape(B, -1)), kc, vc
 
     return jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(pipeline_param_specs(params), P(), P(), kv_spec, kv_spec, P()),
-        out_specs=(P(), kv_spec, kv_spec),
+        in_specs=(pipeline_param_specs(params), P(), P(), KV_SPEC, KV_SPEC, P()),
+        out_specs=(P(), KV_SPEC, KV_SPEC),
         check_vma=False,
     )(params, tokens, seq_lens, k_cache, v_cache, page_table)
+
+
+def pp_forward_prefill_chunk(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, C] one chunk of the prompt, right-padded
+    start: jnp.ndarray,  # [B] global position of the chunk's first token
+    chunk_lens: jnp.ndarray,  # [B] valid tokens in this chunk
+    k_cache: jnp.ndarray,  # [L, S, Hk, hd], L sharded over "pipe"
+    v_cache: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, max_pages] — covers prefix AND chunk
+    page_size: int,
+    mesh: Mesh,
+    n_micro: Optional[int] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Pipelined chunked prefill (long prompts beyond the largest bucket);
+    chaining chunks reproduces pp_forward_prefill exactly. Returns
+    (last-valid-position logits [B, V], caches')."""
+    B, C = tokens.shape
+    pipe = mesh.shape[AXIS_PIPE]
+    M = n_microbatches(B, pipe, n_micro)
+    mb = B // M
+
+    def body(params, tokens, start, chunk_lens, kc, vc, pt):
+        x_all = _embed_lookup(params["embed"], tokens).reshape(M, mb, C, -1)
+        pos_b = start[:, None] + jnp.broadcast_to(
+            jnp.arange(C, dtype=jnp.int32), (B, C)
+        )
+        slots_all = flat_slot_indices(pt, pos_b, page_size).reshape(M, mb, C)
+        pos_all = pos_b.reshape(M, mb, C)
+        start_all = start.reshape(M, mb)
+        clen_all = chunk_lens.reshape(M, mb)
+        pt_all = pt.reshape(M, mb, -1)
+
+        def run_stage(m, valid, inp, kc, vc):
+            st, cl = _pick(start_all, m), _pick(clen_all, m)
+            ptm = _pick(pt_all, m)
+            slots = jnp.where(valid, _pick(slots_all, m), 0)  # bubbles->trash
+
+            def attn_and_cache(q, k, v, kcl, vcl):
+                kcl = kcl.at[slots].set(k)
+                vcl = vcl.at[slots].set(v)
+                # Blockwise online-softmax walk over the already-written
+                # prefix + this chunk (mirrors forward_prefill_chunk).
+                attn = paged_chunk_attention_blockwise(
+                    q, kcl, vcl, ptm, st, cl, page_size
+                )
+                return attn, kcl, vcl
+
+            h_out, kc, vc = _stage(cfg, params["layers"], inp,
+                                   _pick(pos_all, m), kc, vc, attn_and_cache)
+            return h_out, kc, vc, _last_valid(h_out, cl)
+
+        out_x, kc, vc = _pipeline_schedule(pipe, M, x_all, kc, vc, run_stage)
+        return _final_logits(params, cfg, out_x.reshape(B, -1)), kc, vc
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(pipeline_param_specs(params), P(), P(), P(), KV_SPEC,
+                  KV_SPEC, P()),
+        out_specs=(P(), KV_SPEC, KV_SPEC),
+        check_vma=False,
+    )(params, tokens, start, chunk_lens, k_cache, v_cache, page_table)
 
 
 def pp_forward_decode(
@@ -291,55 +354,38 @@ def pp_forward_decode(
     pipe = mesh.shape[AXIS_PIPE]
     M = n_microbatches(B, pipe, n_micro)
     mb = B // M
-    kv_spec = P(AXIS_PIPE, None, AXIS_TENSOR, None)
 
     def body(params, tokens, positions, kc, vc, pt):
-        p = lax.axis_index(AXIS_PIPE)
-        x = _embed_lookup(params["embed"], tokens)  # [B, D]
-        x_all = x.reshape(M, mb, 1, -1)
+        x_all = _embed_lookup(params["embed"], tokens).reshape(M, mb, 1, -1)
         ws_all = flat_slot_indices(pt, positions[:, None], page_size)[:, 0]
         ws_all = ws_all.reshape(M, mb)
         pos_all = positions.reshape(M, mb)
         pt_all = pt.reshape(M, mb, -1)
-        lens_all = pos_all + 1
-        out_x = jnp.zeros((M, mb, x.shape[-1]), x.dtype)
-        h0 = jnp.zeros((mb, 1, x.shape[-1]), x.dtype)
 
-        def step(t, carry):
-            h_state, kc, vc, out_x = carry
-            m = jnp.clip(t - p, 0, M - 1)
-            valid = (t >= p) & (t - p < M)
-            inp = jnp.where(
-                p == 0,
-                lax.dynamic_index_in_dim(x_all, m, 0, keepdims=False),
-                h_state,
-            )
-            pos = lax.dynamic_index_in_dim(pos_all, m, 0, keepdims=False)
-            lens = lax.dynamic_index_in_dim(lens_all, m, 0, keepdims=False)
-            ptm = lax.dynamic_index_in_dim(pt_all, m, 0, keepdims=False)
-            ws = lax.dynamic_index_in_dim(ws_all, m, 0, keepdims=False)
-            ws = jnp.where(valid, ws, 0)  # bubbles write to trash
-            h_out, kc, vc = _stage_decode(
-                cfg, params["layers"], inp, pos, ws, kc, vc, ptm, lens,
-                page_size,
-            )
-            prev = lax.dynamic_index_in_dim(out_x, m, 0, keepdims=False)
-            row = jnp.where(valid & (p == pipe - 1), h_out[:, 0], prev)
-            out_x = lax.dynamic_update_index_in_dim(out_x, row, m, 0)
-            perm = [(d, (d + 1) % pipe) for d in range(pipe)]
-            h_nxt = lax.ppermute(h_out, AXIS_PIPE, perm)
-            return h_nxt, kc, vc, out_x
+        def run_stage(m, valid, inp, kc, vc):
+            pos = _pick(pos_all, m)
+            ptm = _pick(pt_all, m)
+            ws = jnp.where(valid, _pick(ws_all, m), 0)  # bubbles->trash
 
-        _, kc, vc, out_x = lax.fori_loop(
-            0, M + pipe - 1, step, (h0, kc, vc, out_x)
-        )
-        logits = _final_logits(params, cfg, out_x.reshape(B, -1))
-        return logits, kc, vc
+            def attn_and_cache(q, k, v, kcl, vcl):
+                kcl = kcl.at[ws].set(k[:, 0])
+                vcl = vcl.at[ws].set(v[:, 0])
+                attn = paged_decode_attention(
+                    q[:, 0], kcl, vcl, ptm, pos + 1, page_size
+                )
+                return attn[:, None], kcl, vcl  # [mb, 1, H_loc, hd]
+
+            h_out, kc, vc = _stage(cfg, params["layers"], inp, pos[:, None],
+                                   kc, vc, attn_and_cache)
+            return h_out, kc, vc, h_out[:, 0]
+
+        out_x, kc, vc = _pipeline_schedule(pipe, M, x_all, kc, vc, run_stage)
+        return _final_logits(params, cfg, out_x.reshape(B, -1)), kc, vc
 
     return jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(pipeline_param_specs(params), P(), P(), kv_spec, kv_spec, P()),
-        out_specs=(P(), kv_spec, kv_spec),
+        in_specs=(pipeline_param_specs(params), P(), P(), KV_SPEC, KV_SPEC, P()),
+        out_specs=(P(), KV_SPEC, KV_SPEC),
         check_vma=False,
     )(params, tokens, positions, k_cache, v_cache, page_table)

@@ -23,7 +23,7 @@ from typing import Any, Dict
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
-from ollamamq_tpu.parallel.mesh import AXIS_TENSOR
+from ollamamq_tpu.parallel.mesh import AXIS_EXPERT, AXIS_PIPE, AXIS_TENSOR
 
 
 def param_partition_specs(params: Dict[str, Any]) -> Dict[str, Any]:
@@ -39,6 +39,12 @@ def param_partition_specs(params: Dict[str, Any]) -> Dict[str, Any]:
             return PS(*([None] * (nd - 1)), AXIS_TENSOR)  # column-parallel
         if name in ("wo", "w_down") and nd >= 2:
             return PS(*([None] * (nd - 2)), AXIS_TENSOR, None)  # row-parallel
+        # MoE: experts over "expert", per-expert FFN dim over "tensor"
+        # (EP x TP composition); the tiny router stays replicated.
+        if name in ("we_gate", "we_up"):  # [L, E, D, F]
+            return PS(None, AXIS_EXPERT, None, AXIS_TENSOR)
+        if name == "we_down":  # [L, E, F, D]
+            return PS(None, AXIS_EXPERT, AXIS_TENSOR, None)
         if name in ("bq", "bk", "bv") and nd >= 1:
             return PS(*([None] * (nd - 1)), AXIS_TENSOR)
         if name in ("embed", "lm_head"):
@@ -48,14 +54,38 @@ def param_partition_specs(params: Dict[str, Any]) -> Dict[str, Any]:
     return _named_map(spec_for, params)
 
 
-def kv_cache_spec() -> PS:
-    """KV slot pool [L, slots, kv_heads, head_dim]: heads on tensor axis."""
-    return PS(None, None, AXIS_TENSOR, None)
-
-
-def shard_params(params, mesh: Mesh):
-    """Place a params pytree onto the mesh per the partition rules."""
+def pipeline_param_specs(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Partition specs for PP(xTP): the usual TP specs, plus every leaf of
+    the stacked `layers` subtree sharded over "pipe" on its leading
+    num_layers dim (parallel/pipeline.py stages scan their local slice)."""
     specs = param_partition_specs(params)
+
+    def add_pipe(leaf, spec):
+        dims = list(spec) + [None] * (leaf.ndim - len(spec))
+        dims[0] = AXIS_PIPE
+        return PS(*dims)
+
+    specs["layers"] = jax.tree_util.tree_map(
+        add_pipe, params["layers"], specs["layers"]
+    )
+    return specs
+
+
+def kv_cache_spec(pp: bool = False) -> PS:
+    """KV slot pool [L, slots, kv_heads, head_dim]: heads on tensor axis;
+    under pipeline parallelism layers also split over the pipe axis."""
+    return PS(AXIS_PIPE if pp else None, None, AXIS_TENSOR, None)
+
+
+def shard_params(params, mesh: Mesh, pp: bool = False):
+    """Place a params pytree onto the mesh per the partition rules.
+
+    `pp=True` additionally splits layer stacks over the pipe axis — the
+    CALLER decides, because only runtimes that actually run the pipelined
+    forwards (parallel/pipeline.py) want pipe-sharded weights; an encoder
+    or embed runtime sharing a --pp mesh runs plain GSPMD scans and must
+    keep layers pipe-replicated."""
+    specs = pipeline_param_specs(params) if pp else param_partition_specs(params)
     return jax.tree_util.tree_map(
         lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs
     )

@@ -97,6 +97,55 @@ def test_pp2_batch_not_multiple_of_stages():
     _run_both(cfg, make_mesh(dp=1, pp=4, tp=1), B=6)
 
 
+def test_pp_engine_serves_generate_and_long_prompt():
+    """Full serving path under --pp 2: bucketed prefill, fused decode, and
+    the chunked long-prompt path all route through the pipelined forwards
+    and produce the same greedy text as a pp=1 engine."""
+    from ollamamq_tpu.config import EngineConfig
+    from ollamamq_tpu.engine.engine import TPUEngine
+    from ollamamq_tpu.engine.request import Request
+    from ollamamq_tpu.ops.sampling import SamplingParams
+    from testutil import collect
+
+    def mk(pp):
+        cfg = EngineConfig(
+            model="test-tiny", max_slots=4, num_pages=64, page_size=8,
+            max_pages_per_seq=16, prefill_buckets=(16, 32, 64),
+            max_new_tokens=16, decode_steps_per_iter=4, pp=pp,
+            dtype="float32",
+        )
+        eng = TPUEngine(cfg, blocklist_path=None)
+        eng.start()
+        return eng
+
+    ref, pp = mk(1), mk(2)
+    try:
+        assert pp.runtimes["test-tiny"]._pp == 2
+        # A pp runtime serves generate only: embed over pipe-sharded layer
+        # stacks would all-gather each stage's weights (OOM on the >HBM
+        # models pp targets), so the kind-gate must reject it cleanly.
+        assert pp.runtimes["test-tiny"].SERVES == ("generate",)
+        assert ref.runtimes["test-tiny"].SERVES == ("generate", "embed")
+        # Short prompt (bucketed prefill) and a prompt past the largest
+        # bucket (chunked prefill), both compared greedy-vs-greedy.
+        for prompt in ("hello pipeline world", "long " * 20):
+            texts = []
+            for eng in (ref, pp):
+                tok = eng.runtimes["test-tiny"].tokenizer
+                rid = eng.core.enqueue("u", "127.0.0.1", "test-tiny")
+                req = Request(rid, "u", "test-tiny", tok.encode(prompt),
+                              SamplingParams(max_tokens=8))
+                eng.submit(req)
+                items = collect(req, timeout=180)
+                assert items[-1].kind == "done", items[-1].error
+                texts.append("".join(i.text for i in items
+                                     if i.kind == "token"))
+            assert texts[0] == texts[1], (prompt, texts)
+    finally:
+        ref.stop()
+        pp.stop()
+
+
 def test_n_microbatches_helper():
     assert pipeline.n_microbatches(8, 4) == 4
     assert pipeline.n_microbatches(6, 4) == 3

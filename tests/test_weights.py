@@ -104,3 +104,70 @@ def test_orbax_round_trip(tmp_path, tiny_cfg, tiny_params):
         np.asarray(via_resolver["embed"]),
         np.asarray(tiny_params["embed"]), rtol=1e-6,
     )
+
+
+def test_safetensors_mixtral_moe_layout(tmp_path):
+    import jax.numpy as jnp
+
+    cfg = MODEL_CONFIGS["test-tiny-moe"]
+    rng = np.random.default_rng(1)
+    from safetensors.numpy import save_file
+
+    d, f, E = cfg.hidden_size, cfg.intermediate_size, cfg.num_experts
+    tensors = {
+        "model.embed_tokens.weight": rng.normal(
+            size=(cfg.vocab_size, d)).astype(np.float32),
+        "model.norm.weight": np.ones((d,), np.float32),
+        "lm_head.weight": rng.normal(
+            size=(cfg.vocab_size, d)).astype(np.float32),
+    }
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        tensors[p + "input_layernorm.weight"] = np.ones((d,), np.float32)
+        tensors[p + "post_attention_layernorm.weight"] = np.ones((d,), np.float32)
+        tensors[p + "self_attn.q_proj.weight"] = rng.normal(
+            size=(cfg.q_dim, d)).astype(np.float32)
+        tensors[p + "self_attn.k_proj.weight"] = rng.normal(
+            size=(cfg.kv_dim, d)).astype(np.float32)
+        tensors[p + "self_attn.v_proj.weight"] = rng.normal(
+            size=(cfg.kv_dim, d)).astype(np.float32)
+        tensors[p + "self_attn.o_proj.weight"] = rng.normal(
+            size=(d, cfg.q_dim)).astype(np.float32)
+        tensors[p + "block_sparse_moe.gate.weight"] = rng.normal(
+            size=(E, d)).astype(np.float32)
+        for e in range(E):
+            ep = p + f"block_sparse_moe.experts.{e}."
+            tensors[ep + "w1.weight"] = rng.normal(size=(f, d)).astype(np.float32)
+            tensors[ep + "w2.weight"] = rng.normal(size=(d, f)).astype(np.float32)
+            tensors[ep + "w3.weight"] = rng.normal(size=(f, d)).astype(np.float32)
+    save_file(tensors, str(tmp_path / "model.safetensors"))
+
+    params = weights.load_safetensors(cfg, str(tmp_path), dtype=jnp.float32)
+    L = cfg.num_layers
+    assert params["layers"]["w_router"].shape == (L, d, E)
+    assert params["layers"]["we_gate"].shape == (L, E, d, f)
+    assert params["layers"]["we_down"].shape == (L, E, f, d)
+    assert "w_gate" not in params["layers"]  # no dense FFN in an MoE tree
+    np.testing.assert_allclose(
+        np.asarray(params["layers"]["we_gate"][0, 1]),
+        tensors["model.layers.0.block_sparse_moe.experts.1.w1.weight"].T,
+        rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(params["layers"]["w_router"][0]),
+        tensors["model.layers.0.block_sparse_moe.gate.weight"].T,
+        rtol=1e-6,
+    )
+
+    # The loaded MoE checkpoint actually runs a prefill.
+    from ollamamq_tpu.engine import kv_cache as kvc
+    from ollamamq_tpu.models import llama
+
+    kc = jnp.zeros((L, 64, cfg.num_kv_heads, cfg.head_dim), jnp.float32)
+    a = kvc.PageAllocator(8, 8, 4)
+    pt = jnp.asarray(np.stack([kvc.make_page_table_row(a.alloc(4), 4)]))
+    logits, _, _ = llama.forward_prefill(
+        params, cfg, jnp.array([[1, 2, 3, 4]], jnp.int32), jnp.array([4]),
+        kc, jnp.zeros_like(kc), pt, 8,
+    )
+    assert np.isfinite(np.asarray(logits)).all()
