@@ -62,6 +62,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "chip groups; for models beyond one group's HBM)")
     p.add_argument("--ep", type=int, default=1,
                    help="expert-parallel axis size (MoE models)")
+    p.add_argument("--pp-microbatches", type=int, default=0,
+                   help="GPipe microbatches per pp dispatch (0 = one per "
+                        "stage; sweep on hardware — prefill wants more, "
+                        "weight-bound decode may want fewer)")
     p.add_argument("--token-fairness", action="store_true",
                    help="fair-share by served tokens instead of request count")
     p.add_argument("--spmd", action="store_true",
@@ -136,6 +140,7 @@ def main(argv=None) -> int:
         tp=args.tp,
         pp=args.pp,
         ep=args.ep,
+        pp_microbatches=args.pp_microbatches or None,
     )
     fairness = Fairness.TOKENS if args.token_fairness else Fairness.REQUESTS
 

@@ -31,6 +31,8 @@ class ModelConfig:
     tie_embeddings: bool = False
     # Qwen2-style attention projections carry a bias term; Llama's do not.
     attn_bias: bool = False
+    # Qwen3-style per-head RMSNorm on q and k after projection (pre-RoPE).
+    qk_norm: bool = False
     # Bidirectional attention + mean pooling => embedding encoder, not a LM.
     is_encoder: bool = False
     # Mixture-of-experts (Mixtral family): 0 = dense FFN. When > 0, each
@@ -132,6 +134,18 @@ MODEL_CONFIGS = {
         head_dim=16, rope_theta=1000.0, max_seq_len=512, tie_embeddings=True,
         is_encoder=True,
     ),
+    # Qwen3 family: per-head q/k RMSNorm, no attention bias.
+    "qwen3:8b": ModelConfig(
+        name="qwen3:8b", vocab_size=151_936, hidden_size=4096,
+        intermediate_size=12_288, num_layers=36, num_heads=32,
+        num_kv_heads=8, head_dim=128, rope_theta=1_000_000.0,
+        max_seq_len=32_768, qk_norm=True,
+    ),
+    "test-tiny-qwen3": ModelConfig(
+        name="test-tiny-qwen3", vocab_size=512, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, rope_theta=10_000.0, max_seq_len=512, qk_norm=True,
+    ),
     # Mixture-of-experts family (Mixtral 8x7b architecture description).
     "mixtral:8x7b": ModelConfig(
         name="mixtral:8x7b", vocab_size=32_000, hidden_size=4096,
@@ -216,6 +230,12 @@ class EngineConfig:
     tp: int = 1
     pp: int = 1
     ep: int = 1
+    # GPipe microbatches per pp dispatch (None -> one per stage). The right
+    # value is workload-dependent: prefill is compute-bound (more
+    # microbatches shrink the (P-1)/(M+P-1) bubble) while decode is
+    # weight-streaming-bound (each microbatch step re-streams the stage's
+    # weights, so FEWER can win) — sweep on hardware.
+    pp_microbatches: Optional[int] = None
     dtype: str = "bfloat16"
     seed: int = 0
 

@@ -451,12 +451,14 @@ class ModelRuntime:
             cfg, ps = self.cfg, self.ecfg.page_size
             need_pen, need_mask, need_sample = flags
             pp, mesh = self._pp, self.mesh
+            n_micro = self.ecfg.pp_microbatches
 
             def fn(params, tokens, seq_lens, kc, vc, recent, slot_ids, pt,
                    temp, tk, tp, pen, pres, freq, seeds, key):
                 if pp > 1:
                     logits, kc, vc = pipeline.pp_forward_prefill(
-                        params, cfg, tokens, seq_lens, kc, vc, pt, ps, mesh
+                        params, cfg, tokens, seq_lens, kc, vc, pt, ps, mesh,
+                        n_micro=n_micro,
                     )
                 else:
                     logits, kc, vc = llama.forward_prefill(
@@ -490,13 +492,14 @@ class ModelRuntime:
             cfg, ps = self.cfg, self.ecfg.page_size
             need_pen, need_mask, need_sample = flags
             pp, mesh = self._pp, self.mesh
+            n_micro = self.ecfg.pp_microbatches
 
             def fn(params, tokens, start, chunk_lens, kc, vc, recent, slot_id,
                    is_final, pt, temp, tk, tp, pen, pres, freq, seeds, key):
                 if pp > 1:
                     logits, kc, vc = pipeline.pp_forward_prefill_chunk(
                         params, cfg, tokens, start, chunk_lens, kc, vc, pt,
-                        ps, mesh
+                        ps, mesh, n_micro=n_micro,
                     )
                 else:
                     logits, kc, vc = llama.forward_prefill_chunk(
@@ -637,6 +640,7 @@ class ModelRuntime:
             attn_impl = self.attn_impl
             need_pen, need_mask, need_sample = flags
             pp, mesh = self._pp, self.mesh
+            n_micro = self.ecfg.pp_microbatches
 
             def fn(params, tokens, positions, kc, vc, recent, active, pt,
                    temp, tk, tp, pen, pres, freq, seeds, key):
@@ -647,7 +651,7 @@ class ModelRuntime:
                     if pp > 1:
                         logits, kc, vc = pipeline.pp_forward_decode(
                             params, cfg, tokens, positions, kc, vc, pt, ps,
-                            mesh
+                            mesh, n_micro=n_micro,
                         )
                     else:
                         logits, kc, vc = llama.forward_decode(

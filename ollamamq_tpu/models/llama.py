@@ -63,6 +63,10 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
         layers["bq"] = jnp.zeros((L, qd), dtype)
         layers["bk"] = jnp.zeros((L, kvd), dtype)
         layers["bv"] = jnp.zeros((L, kvd), dtype)
+    if cfg.qk_norm:
+        # Qwen3: per-head RMSNorm on q/k (weight over head_dim).
+        layers["q_norm"] = jnp.ones((L, cfg.head_dim), dtype)
+        layers["k_norm"] = jnp.ones((L, cfg.head_dim), dtype)
     if cfg.num_experts:
         # MoE family: the dense FFN is replaced by routed experts.
         from ollamamq_tpu.models.moe import init_moe_layer_params
@@ -93,6 +97,9 @@ def _qkv(cfg: ModelConfig, lp: dict, h: jnp.ndarray):
     q = q.reshape(B, T, cfg.num_heads, cfg.head_dim)
     k = k.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
     v = v.reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    if cfg.qk_norm:
+        q = rmsnorm(q, lp["q_norm"], cfg.rms_norm_eps)
+        k = rmsnorm(k, lp["k_norm"], cfg.rms_norm_eps)
     return q, k, v
 
 

@@ -31,6 +31,8 @@ _HF_LAYER_MAP = {
     "self_attn.v_proj.weight": ("wv", True),
     "self_attn.o_proj.weight": ("wo", True),
     "self_attn.q_proj.bias": ("bq", False),
+    "self_attn.q_norm.weight": ("q_norm", False),
+    "self_attn.k_norm.weight": ("k_norm", False),
     "self_attn.k_proj.bias": ("bk", False),
     "self_attn.v_proj.bias": ("bv", False),
     "post_attention_layernorm.weight": ("mlp_norm", False),

@@ -91,6 +91,9 @@ def _tp_qkv(cfg: ModelConfig, lp: dict, h: jnp.ndarray):
     q = q.reshape(B, T, q.shape[-1] // hd, hd)
     k = k.reshape(B, T, k.shape[-1] // hd, hd)
     v = v.reshape(B, T, v.shape[-1] // hd, hd)
+    if cfg.qk_norm:
+        q = rmsnorm(q, lp["q_norm"], cfg.rms_norm_eps)
+        k = rmsnorm(k, lp["k_norm"], cfg.rms_norm_eps)
     return q, k, v
 
 

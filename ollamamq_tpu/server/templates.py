@@ -14,15 +14,26 @@ from ollamamq_tpu.config import ModelConfig
 
 
 def chat_family(cfg: Optional[ModelConfig]) -> str:
-    """'chatml' | 'llama3' | 'plain' — the ONE place the template-family
-    heuristics live. render_chat and template_owns_bos both read this, so
-    the dispatch can't silently drift between them (a divergence doubles
-    or drops the BOS on every chat prompt)."""
+    """'chatml' | 'llama3' | 'mistral' | 'plain' — the ONE place the
+    template-family heuristics live. render_chat and template_owns_bos
+    both read this, so the dispatch can't silently drift between them (a
+    divergence doubles or drops the BOS on every chat prompt).
+
+    Name prefix decides first (qwen3 has no attention bias and mixtral's
+    vocab is small — architecture markers alone misroute both); the
+    architecture heuristics remain for unregistered checkpoints."""
     if cfg is None:
         return "plain"
+    name = cfg.name.lower()
+    if name.startswith(("qwen",)):
+        return "chatml"
+    if name.startswith(("mixtral", "mistral")):
+        return "mistral"
+    if name.startswith(("llama3", "llama-3")):
+        return "llama3"
     if cfg.attn_bias:  # Qwen2 family marker
         return "chatml"
-    if not cfg.is_encoder and cfg.vocab_size > 100_000:
+    if not cfg.is_encoder and cfg.num_experts == 0 and cfg.vocab_size > 100_000:
         return "llama3"
     return "plain"
 
@@ -30,8 +41,8 @@ def chat_family(cfg: Optional[ModelConfig]) -> str:
 def template_owns_bos(cfg: Optional[ModelConfig]) -> bool:
     """True when the chat template emits its own begin-of-sequence text
     (Llama-3's <|begin_of_text|>) or the format defines none (ChatML).
-    Plain-fallback models still need the tokenizer's BOS prepended —
-    callers pass add_bos=not template_owns_bos(cfg) to encode()."""
+    Plain-fallback and Mistral-[INST] models still need the tokenizer's
+    BOS prepended — callers pass add_bos=not template_owns_bos(cfg)."""
     return chat_family(cfg) in ("chatml", "llama3")
 
 
@@ -53,6 +64,23 @@ def render_chat(messages: List[dict], cfg: Optional[ModelConfig]) -> str:
         for role, content in msgs:
             out.append(f"<|im_start|>{role}\n{content}<|im_end|>\n")
         out.append("<|im_start|>assistant\n")
+        return "".join(out)
+
+    if family == "mistral":
+        # Mixtral/Mistral instruct format: system text folds into the
+        # first user turn; assistant turns close with </s>.
+        out = []
+        pending_sys = ""
+        for role, content in msgs:
+            if role == "system":
+                pending_sys += content + "\n\n"
+            elif role == "assistant":
+                out.append(f"{content}</s>")
+            else:
+                out.append(f"[INST] {pending_sys}{content} [/INST]")
+                pending_sys = ""
+        if pending_sys:
+            out.append(f"[INST] {pending_sys.strip()} [/INST]")
         return "".join(out)
 
     if family == "llama3":

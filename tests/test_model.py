@@ -156,6 +156,28 @@ def test_qwen_bias_config():
     assert bool(jnp.all(jnp.isfinite(logits)))
 
 
+def test_qwen3_qk_norm():
+    cfg = MODEL_CONFIGS["test-tiny-qwen3"]
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    assert "q_norm" in params["layers"] and "bq" not in params["layers"]
+    kc, vc = _fresh_cache(cfg)
+    a = kvc.PageAllocator(32, PAGE_SIZE, MAX_PAGES)
+    pt = _page_table(a, [a.alloc(4)])
+    toks = jnp.array([[1, 2, 3, 4]], jnp.int32)
+    logits, _, _ = llama.forward_prefill(
+        params, cfg, toks, jnp.array([4]), kc, vc, pt, PAGE_SIZE
+    )
+    assert bool(jnp.all(jnp.isfinite(logits)))
+    # The norm is actually in the path: scaling its weight changes logits.
+    bent = dict(params, layers=dict(params["layers"]))
+    bent["layers"]["q_norm"] = params["layers"]["q_norm"] * 3.0
+    kc2, vc2 = _fresh_cache(cfg)
+    logits2, _, _ = llama.forward_prefill(
+        bent, cfg, toks, jnp.array([4]), kc2, vc2, pt, PAGE_SIZE
+    )
+    assert not np.allclose(np.asarray(logits), np.asarray(logits2))
+
+
 def test_encoder_embeddings():
     cfg = MODEL_CONFIGS["test-tiny-embed"]
     params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)

@@ -89,6 +89,12 @@ def test_pp2_x_tp2_composition():
     _run_both(cfg, make_mesh(dp=1, pp=2, tp=2))
 
 
+def test_pp2_qwen3_qk_norm():
+    # Per-head q/k RMSNorm must match inside the stage body too.
+    cfg = MODEL_CONFIGS["test-tiny-qwen3"]
+    _run_both(cfg, make_mesh(dp=1, pp=2, tp=2))
+
+
 def test_pp2_batch_not_multiple_of_stages():
     # B=6 with pp=4 -> n_micro falls back to 3; schedule still exact.
     cfg = dataclasses.replace(

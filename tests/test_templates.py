@@ -1,0 +1,70 @@
+"""Chat-template family dispatch + rendering.
+
+With inference in-tree, templating is ours (the reference forwarded chat
+bodies to Ollama). The family heuristics misrouting a model silently
+degrades every chat completion, so each family's dispatch is pinned here.
+"""
+
+from ollamamq_tpu.config import MODEL_CONFIGS
+from ollamamq_tpu.server.templates import (
+    chat_family,
+    render_chat,
+    template_owns_bos,
+)
+
+MSGS = [
+    {"role": "system", "content": "be brief"},
+    {"role": "user", "content": "hi"},
+]
+
+
+def test_family_dispatch():
+    assert chat_family(MODEL_CONFIGS["llama3:8b"]) == "llama3"
+    assert chat_family(MODEL_CONFIGS["llama3.2:1b"]) == "llama3"
+    assert chat_family(MODEL_CONFIGS["qwen2.5:7b"]) == "chatml"
+    # qwen3 has NO attention bias — the name, not the bias, must route it.
+    assert chat_family(MODEL_CONFIGS["qwen3:8b"]) == "chatml"
+    # mixtral's 32k vocab fails the llama3 size heuristic — name routes it.
+    assert chat_family(MODEL_CONFIGS["mixtral:8x7b"]) == "mistral"
+    assert chat_family(MODEL_CONFIGS["test-tiny"]) == "plain"
+    assert chat_family(None) == "plain"
+
+
+def test_llama3_render():
+    out = render_chat(MSGS, MODEL_CONFIGS["llama3:8b"])
+    assert out.startswith("<|begin_of_text|>")
+    assert "<|start_header_id|>system<|end_header_id|>\n\nbe brief<|eot_id|>" in out
+    assert out.endswith("<|start_header_id|>assistant<|end_header_id|>\n\n")
+    assert template_owns_bos(MODEL_CONFIGS["llama3:8b"])
+
+
+def test_chatml_render_qwen3():
+    out = render_chat(MSGS, MODEL_CONFIGS["qwen3:8b"])
+    assert out.startswith("<|im_start|>system\nbe brief<|im_end|>\n")
+    assert out.endswith("<|im_start|>assistant\n")
+    assert template_owns_bos(MODEL_CONFIGS["qwen3:8b"])
+
+
+def test_mistral_render():
+    cfg = MODEL_CONFIGS["mixtral:8x7b"]
+    out = render_chat(MSGS, cfg)
+    # System text folds into the first user turn.
+    assert out == "[INST] be brief\n\nhi [/INST]"
+    assert not template_owns_bos(cfg)  # tokenizer still prepends BOS
+    # Multi-turn: assistant replies close with </s>.
+    multi = MSGS + [{"role": "assistant", "content": "hello"},
+                    {"role": "user", "content": "more"}]
+    out2 = render_chat(multi, cfg)
+    assert out2 == "[INST] be brief\n\nhi [/INST]hello</s>[INST] more [/INST]"
+    # Two system messages both survive (append, not overwrite).
+    two_sys = [{"role": "system", "content": "A"},
+               {"role": "system", "content": "B"},
+               {"role": "user", "content": "hi"}]
+    assert render_chat(two_sys, cfg) == "[INST] A\n\nB\n\nhi [/INST]"
+
+
+def test_openai_content_parts():
+    msgs = [{"role": "user",
+             "content": [{"type": "text", "text": "a"},
+                         {"type": "text", "text": "b"}]}]
+    assert "ab" in render_chat(msgs, None)
