@@ -11,6 +11,12 @@ set -- --no-tui --host 0.0.0.0
 [ -n "${TIMEOUT:-}" ] && set -- "$@" --timeout "$TIMEOUT"
 [ -n "${TP:-}" ] && set -- "$@" --tp "$TP"
 [ -n "${DP:-}" ] && set -- "$@" --dp "$DP"
+[ -n "${SP:-}" ] && set -- "$@" --sp "$SP"
+[ -n "${PP:-}" ] && set -- "$@" --pp "$PP"
+[ -n "${EP:-}" ] && set -- "$@" --ep "$EP"
+[ -n "${PAGE_SIZE:-}" ] && set -- "$@" --page-size "$PAGE_SIZE"
+[ -n "${NUM_PAGES:-}" ] && set -- "$@" --num-pages "$NUM_PAGES"
+[ "${SPMD:-}" = "true" ] && set -- "$@" --spmd
 [ -n "${MAX_SLOTS:-}" ] && set -- "$@" --max-slots "$MAX_SLOTS"
 [ -n "${BLOCKLIST:-}" ] && set -- "$@" --blocklist "$BLOCKLIST"
 [ "${ALLOW_ALL_ROUTES:-}" = "true" ] && set -- "$@" --allow-all-routes

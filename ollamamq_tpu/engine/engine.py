@@ -231,12 +231,14 @@ class ModelRuntime:
             self.SERVES = ("generate",)
             log.info("%s: pp=%d runtime serves generate only "
                      "(embed needs pipe-replicated layers)", name, self._pp)
+        # Dense models on an --ep mesh are fine (their weights carry no
+        # expert-axis spec, so they replicate over it); only an MoE model
+        # whose expert count doesn't divide is a real layout error.
         ep = dict(mesh.shape).get("expert", 1) if mesh is not None else 1
-        if ep > 1 and (model_cfg.num_experts == 0
-                       or model_cfg.num_experts % ep != 0):
+        if ep > 1 and model_cfg.num_experts and model_cfg.num_experts % ep:
             raise ValueError(
-                f"ep={ep} needs an MoE model with num_experts divisible by "
-                f"it ({name} has {model_cfg.num_experts})")
+                f"ep={ep} must divide num_experts={model_cfg.num_experts} "
+                f"({name})")
         # `preloaded_params`: host-side tree shared across dp replicas so a
         # checkpoint is read/parsed once, not once per replica; each replica
         # still device_puts its own copy via shard_params below.

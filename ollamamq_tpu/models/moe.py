@@ -60,6 +60,19 @@ def expert_capacity(n_tokens: int, cfg: ModelConfig) -> int:
     return max(1, int(math.ceil(ideal * cfg.moe_capacity_factor)))
 
 
+def group_size(n_tokens: int, cap: int = 512) -> int:
+    """Tokens per routing group: largest divisor of n_tokens <= cap.
+
+    Without grouping, capacity C grows with N and the dispatch one-hots /
+    einsums scale O(N^2) — a long-prefill HBM and FLOPs blowup. GShard's
+    fix is a group dimension: capacity is computed per fixed-size group,
+    so dispatch cost stays linear in tokens."""
+    g = min(cap, n_tokens)
+    while n_tokens % g:
+        g -= 1
+    return max(g, 1)
+
+
 def moe_mlp(cfg: ModelConfig, lp: dict, h: jnp.ndarray,
             valid=None) -> jnp.ndarray:
     """Top-k routed expert FFN over [B, T, D] hiddens; returns [B, T, D].
@@ -69,45 +82,52 @@ def moe_mlp(cfg: ModelConfig, lp: dict, h: jnp.ndarray,
     and inactive decode slots must not CLAIM expert capacity, or identical
     garbage rows (all routing alike) crowd real tokens out of their
     experts' queues and silently zero their FFN delta.
+
+    Tokens route in groups of <= 512 (GShard's group dim): capacity and
+    the dispatch/combine one-hots are per-group, keeping dispatch cost
+    linear in sequence length.
     """
     B, T, D = h.shape
     E, K = cfg.num_experts, cfg.num_experts_per_tok
     N = B * T
-    C = expert_capacity(N, cfg)
-    x = h.reshape(N, D)
+    G = group_size(N)
+    n_g = N // G
+    C = expert_capacity(G, cfg)
+    x = h.reshape(n_g, G, D)
 
     # Router in f32: the softmax is over a handful of experts and feeds
     # multiplicative gates — bf16 here costs real quality for no speed.
     logits = jnp.einsum(
-        "nd,de->ne", x.astype(jnp.float32), lp["w_router"].astype(jnp.float32)
+        "gnd,de->gne", x.astype(jnp.float32), lp["w_router"].astype(jnp.float32)
     )
-    probs = jax.nn.softmax(logits, axis=-1)  # [N, E]
-    gate_vals, expert_idx = jax.lax.top_k(probs, K)  # [N, K]
+    probs = jax.nn.softmax(logits, axis=-1)  # [g, G, E]
+    gate_vals, expert_idx = jax.lax.top_k(probs, K)  # [g, G, K]
     gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
 
-    # Position of each (token, k-slot) in its expert's queue, token-major
-    # (GShard "first C win"). sel: [N, K, E] one-hot on the routed expert;
-    # invalid tokens select nothing (and so consume no capacity).
-    sel = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)  # [N, K, E]
+    # Position of each (token, k-slot) in its expert's per-group queue,
+    # token-major (GShard "first C win"). sel: [g, G, K, E] one-hot on the
+    # routed expert; invalid tokens select nothing (=> no capacity claim).
+    sel = jax.nn.one_hot(expert_idx, E, dtype=jnp.int32)
     if valid is not None:
-        sel = sel * valid.reshape(N).astype(jnp.int32)[:, None, None]
-    pos = jnp.cumsum(sel.reshape(N * K, E), axis=0).reshape(N, K, E) - sel
-    keep = (pos < C) & (sel > 0)  # [N, K, E]
+        sel = sel * valid.reshape(n_g, G).astype(jnp.int32)[..., None, None]
+    pos = jnp.cumsum(sel.reshape(n_g, G * K, E), axis=1).reshape(sel.shape) - sel
+    keep = (pos < C) & (sel > 0)  # [g, G, K, E]
 
     # One-hot (token, k-slot) -> (expert, capacity-slot); dropped and
     # unrouted entries point at index C, whose one-hot row is all zeros.
     pos_oh = jax.nn.one_hot(jnp.where(keep, pos, C), C, dtype=h.dtype)
-    dispatch = jnp.sum(pos_oh, axis=1)  # [N, E, C] 0/1 (k-slots disjoint)
+    dispatch = jnp.sum(pos_oh, axis=2)  # [g, G, E, C] 0/1 (k-slots disjoint)
     combine = jnp.einsum(
-        "nkec,nk->nec", pos_oh, gate_vals.astype(h.dtype)
-    )  # [N, E, C] gate weights
+        "gnkec,gnk->gnec", pos_oh, gate_vals.astype(h.dtype)
+    )  # [g, G, E, C] gate weights
 
-    # Expert compute on the dispatched [E, C, D] blocks — the einsums XLA
-    # partitions over "expert"/"tensor" when we_* carry those shardings.
-    xe = jnp.einsum("nec,nd->ecd", dispatch, x)
-    gate = jnp.einsum("ecd,edf->ecf", xe, lp["we_gate"])
-    up = jnp.einsum("ecd,edf->ecf", xe, lp["we_up"])
-    out_e = jnp.einsum("ecf,efd->ecd", jax.nn.silu(gate) * up, lp["we_down"])
+    # Expert compute on the dispatched [g, E, C, D] blocks — the einsums
+    # XLA partitions over "expert"/"tensor" when we_* carry those
+    # shardings (the group dim stays local).
+    xe = jnp.einsum("gnec,gnd->gecd", dispatch, x)
+    gate = jnp.einsum("gecd,edf->gecf", xe, lp["we_gate"])
+    up = jnp.einsum("gecd,edf->gecf", xe, lp["we_up"])
+    out_e = jnp.einsum("gecf,efd->gecd", jax.nn.silu(gate) * up, lp["we_down"])
 
-    y = jnp.einsum("nec,ecd->nd", combine, out_e)  # gates applied here
+    y = jnp.einsum("gnec,gecd->gnd", combine, out_e)  # gates applied here
     return y.reshape(B, T, D)

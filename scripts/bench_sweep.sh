@@ -26,14 +26,14 @@ leg() {
 }
 
 # 1. Current defaults (the shape BENCH_r* runs): chunk sweep inside one leg.
-leg baseline           --slots 64  --page-size 32 --chunk 16 --sweep-chunks 8,32,64
+leg baseline           --slots 64  --page-size 32 --chunk 16 --sweep-chunks 8,32,64,128
 # 2. Page-size neighbors (r3 said 32 > 16; check 64 too).
 leg page16             --slots 64  --page-size 16 --chunk 16
 leg page64             --slots 64  --page-size 64 --chunk 16
 # 3. Batch scaling: decode is weight-streaming bound, so tok/s should rise
 #    with slots until attention/page reads dominate.
 leg slots96            --slots 96  --page-size 32 --chunk 16 --sweep-chunks 32,64
-leg slots128           --slots 128 --page-size 32 --chunk 16 --sweep-chunks 32,64
+leg slots128           --slots 128 --page-size 32 --chunk 16 --sweep-chunks 32,64,128
 # 4. Pallas A/B: same shape, kernel off (env prefix passes through).
 OLLAMAMQ_NO_PALLAS=1 leg slots128_jnp --slots 128 --page-size 32 --chunk 16 --sweep-chunks 32
 # 5. Full-sampler leg (Ollama defaults) on the larger batch.

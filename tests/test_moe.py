@@ -118,6 +118,43 @@ def test_ep_sharded_matches_unsharded():
                                rtol=2e-4, atol=2e-4)
 
 
+def test_grouped_routing_matches_reference_across_groups():
+    """N > group cap: per-group capacity must not change results when
+    capacity is generous (routing is per-token; groups only bound C)."""
+    import ollamamq_tpu.models.moe as moe_mod
+
+    lp, _ = _layer_params(CFG, seed=7)
+    # 2 groups of 8 via a tiny cap — compare against one flat group.
+    h = jax.random.normal(jax.random.PRNGKey(8), (1, 16, CFG.hidden_size),
+                          jnp.float32)
+    want = _reference_moe(CFG, lp, h)
+    orig = moe_mod.group_size
+    try:
+        moe_mod.group_size = lambda n, cap=8: orig(n, cap=8)
+        got = moe_mlp(CFG, lp, h)
+    finally:
+        moe_mod.group_size = orig
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+    assert moe_mod.group_size(16, cap=8) == 8  # really 2 groups
+
+
+def test_dense_model_allowed_on_ep_mesh():
+    """A dense model on an --ep mesh replicates over the expert axis —
+    building its runtime must not raise (multi-model pools mix families)."""
+    from ollamamq_tpu.engine.engine import ModelRuntime
+
+    ecfg = EngineConfig(
+        model="test-tiny", max_slots=2, num_pages=32, page_size=8,
+        max_pages_per_seq=8, prefill_buckets=(16,), dtype="float32",
+    )
+    mesh = make_mesh(dp=1, ep=2, tp=2)
+    import jax.numpy as jnp
+
+    rt = ModelRuntime("test-tiny", MODEL_CONFIGS["test-tiny"], ecfg,
+                      mesh=mesh, dtype=jnp.float32)
+    assert rt is not None
+
+
 def test_engine_serves_moe_end_to_end():
     from ollamamq_tpu.engine.engine import TPUEngine
     from ollamamq_tpu.engine.request import Request
