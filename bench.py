@@ -110,17 +110,25 @@ def main() -> int:
     # ModelRuntime init. A daemon watchdog spanning both phases turns a hang
     # into a structured error line instead of a silent driver timeout.
     # --init-timeout <= 0 disables the watchdog.
+    def arm_watchdog(done: threading.Event, budget: float, phase: str,
+                     exit_code: int, msg: str, **extras) -> None:
+        """One definition for every hang-to-structured-error conversion
+        (init, run, embed): if `done` isn't set within `budget`, emit and
+        exit. Disabled when --init-timeout <= 0."""
+        if args.init_timeout <= 0:
+            return
+
+        def w():
+            if not done.wait(budget):
+                _emit_error(msg, phase=phase, **extras)
+                os._exit(exit_code)
+
+        threading.Thread(target=w, daemon=True).start()
+
     init_done = threading.Event()
-
-    def _watchdog():
-        if not init_done.wait(args.init_timeout):
-            _emit_error(
-                f"device/runtime init exceeded {args.init_timeout:.0f}s "
-                "(wedged TPU tunnel?)", phase="init")
-            os._exit(3)
-
-    if args.init_timeout > 0:
-        threading.Thread(target=_watchdog, daemon=True).start()
+    arm_watchdog(init_done, args.init_timeout, "init", 3,
+                 f"device/runtime init exceeded {args.init_timeout:.0f}s "
+                 "(wedged TPU tunnel?)")
     try:
         dev = jax.devices()[0]
     except Exception as e:
@@ -154,6 +162,35 @@ def main() -> int:
         init_done.set()  # watchdog covers device + runtime init, not the run
     init_s = time.monotonic() - t0
 
+    # Run-phase watchdog: a tunnel that answers init and then wedges
+    # mid-run would otherwise hang the whole bench with nothing emitted —
+    # and the official run may get exactly one shot at a live chip.
+    # INACTIVITY-based so long honest runs (many sweep legs, long prompts)
+    # never trip it: the run touches the deadman after every dispatch, and
+    # only `run_budget` seconds with NO completed dispatch counts as a
+    # wedge. A single decode chunk or prefill taking that long is one.
+    run_done = threading.Event()
+    run_budget = max(600.0, args.init_timeout)
+    deadman = {"t": time.monotonic(), "phase": "ttft"}
+
+    def touch(phase: str) -> None:
+        deadman["t"] = time.monotonic()
+        deadman["phase"] = phase
+
+    if args.init_timeout > 0:
+        def _run_watchdog():
+            while not run_done.wait(15.0):
+                idle = time.monotonic() - deadman["t"]
+                if idle > run_budget:
+                    _emit_error(
+                        f"no progress for {idle:.0f}s in phase "
+                        f"'{deadman['phase']}' after successful init "
+                        "(device wedged mid-run?)", phase=deadman["phase"],
+                        init_s=round(init_s, 1))
+                    os._exit(6)
+
+        threading.Thread(target=_run_watchdog, daemon=True).start()
+
     rng = np.random.default_rng(0)
 
     def make_req(i):
@@ -172,6 +209,7 @@ def main() -> int:
         rt.pending_prefill.append(make_req(1000 + i))
         t0 = time.monotonic()
         rt.step_prefill(core)
+        touch("ttft")
         ttfts.append((time.monotonic() - t0) * 1e3)
         # Clear the slot again so the throughput phase starts clean.
         for s, r in enumerate(rt.slot_req):
@@ -201,6 +239,7 @@ def main() -> int:
             while rt.pending_prefill or rt.chunking:
                 progressed = rt.step_prefill(core)
                 progressed = rt.step_chunk(core) or progressed
+                touch("long_prefill")
                 if not progressed and not rt.chunking:
                     # step_prefill returned False with the request still
                     # pending (page allocation failed): no iteration will
@@ -230,21 +269,25 @@ def main() -> int:
         for i in range(args.slots):
             rt.pending_prefill.append(make_req(i))
             rt.step_prefill(core)
+            touch("batch_prefill")
         return rt.active_count()
 
     def timed_decode(chunk):
         """Warmup (compiles this chunk size) + timed run; returns
         (steps_done, elapsed_s)."""
         rt.step_decode(core, k_steps=chunk)
+        touch("decode_warmup")
         warm_remaining = max(0, args.warmup_steps - chunk)
         while warm_remaining > 0:
             rt.step_decode(core, k_steps=chunk)
+            touch("decode_warmup")
             warm_remaining -= chunk
         done = 0
         t0 = time.monotonic()
         while done < args.steps:
             if rt.step_decode(core, k_steps=chunk) == 0:
                 break
+            touch("decode")
             done += chunk
         return done, time.monotonic() - t0
 
@@ -300,16 +343,9 @@ def main() -> int:
     embed_error = None
     if emodel_cfg is not None:
         embed_done = threading.Event()
-
-        def _embed_watchdog():
-            if not embed_done.wait(args.init_timeout):
-                _emit_error(
-                    f"embed-model init exceeded {args.init_timeout:.0f}s "
-                    "(wedged device?)", phase="embed_init")
-                os._exit(3)
-
-        if args.init_timeout > 0:
-            threading.Thread(target=_embed_watchdog, daemon=True).start()
+        arm_watchdog(embed_done, args.init_timeout, "embed_init", 3,
+                     f"embed-model init exceeded {args.init_timeout:.0f}s "
+                     "(wedged device?)")
         try:
             from ollamamq_tpu.engine.engine import EncoderRuntime
 
@@ -323,6 +359,7 @@ def main() -> int:
                                    prompt, SamplingParams(), kind="embed")
                     ert.pending.append(ereq)
                 ert.step(core)
+                touch("embed")
 
             embed_batch(0)  # compile
             n_batches = 8
@@ -383,6 +420,7 @@ def main() -> int:
             result["embed_tok_per_s"] = round(embed_tok_per_s, 1)
         if embed_error is not None:
             result["embed_error"] = embed_error
+    run_done.set()
     print(json.dumps(result), flush=True)
     return 0
 
