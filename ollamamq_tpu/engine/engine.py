@@ -328,13 +328,16 @@ class ModelRuntime:
             if jax.default_backend() == "tpu" and not no_pallas
             else "jnp"
         )
-        if self._pp > 1 and self.attn_impl == "pallas":
-            # The pipelined decode stage (parallel/pipeline.py) runs the
-            # jnp paged attention — the Pallas kernel is unproven inside
-            # shard_map. Say so rather than silently serving slower.
+        if (self._pp > 1 and self.attn_impl == "pallas"
+                and jax.process_count() > 1):
+            # The AOT compile-probe that turns a Mosaic failure into a jnp
+            # fallback is single-process only (a coordinated multi-host
+            # flip doesn't exist); a cold pp+pallas compile failure on a
+            # pod would fail-loop the runtime. Serve jnp, say so.
             log.warning(
-                "%s: pp=%d decode uses the jnp paged attention, not the "
-                "Pallas kernel", name, self._pp)
+                "%s: pp=%d on %d processes uses the jnp paged attention "
+                "(no multi-host pallas fallback path)", name, self._pp,
+                jax.process_count())
             self.attn_impl = "jnp"
         # Flips true after the first successful decode dispatch; until then
         # a pallas failure falls back to jnp instead of failing the runtime.
@@ -674,9 +677,12 @@ class ModelRuntime:
                 def step(carry, _):
                     tokens, positions, kc, vc, recent, key = carry
                     if pp > 1:
+                        # Pallas runs per-device inside the stage; the AOT
+                        # probe in step_decode_dispatch covers this path
+                        # too (a Mosaic failure flips to jnp as usual).
                         logits, kc, vc = pipeline.pp_forward_decode(
                             params, cfg, tokens, positions, kc, vc, pt, ps,
-                            mesh, n_micro=n_micro,
+                            mesh, n_micro=n_micro, attn_impl=attn_impl,
                         )
                     else:
                         logits, kc, vc = llama.forward_decode(

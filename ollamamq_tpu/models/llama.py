@@ -28,7 +28,7 @@ from ollamamq_tpu.ops.attention import (
     bidirectional_attention,
     flat_slot_indices,
     paged_chunk_attention_blockwise,
-    paged_decode_attention,
+    paged_decode_attention_any,
 )
 from ollamamq_tpu.ops.rope import apply_rope
 
@@ -278,18 +278,9 @@ def forward_decode(
         k = apply_rope(k, pos2, cfg.rope_theta)
         kc = kc.at[write_slots].set(k[:, 0])
         vc = vc.at[write_slots].set(v[:, 0])
-        if attn_impl == "pallas":
-            from ollamamq_tpu.ops.pallas.paged_attention import (
-                paged_decode_attention_pallas,
-            )
-
-            attn = paged_decode_attention_pallas(
-                q[:, 0], kc, vc, page_table, seq_lens, page_size
-            )
-        else:
-            attn = paged_decode_attention(
-                q[:, 0], kc, vc, page_table, seq_lens, page_size
-            )  # [B,H,hd]
+        attn = paged_decode_attention_any(
+            attn_impl, q[:, 0], kc, vc, page_table, seq_lens, page_size
+        )  # [B,H,hd]
         x = x + jnp.einsum("be,ed->bd", attn.reshape(B, cfg.q_dim), lp["wo"])[:, None, :]
         h2 = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + _ffn(cfg, lp, h2, valid=valid)

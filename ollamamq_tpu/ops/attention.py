@@ -209,3 +209,31 @@ def paged_decode_attention(
         page_size=page_size,
     )
     return out[:, 0]
+
+
+def paged_decode_attention_any(
+    attn_impl: str,
+    q: jnp.ndarray,  # [B, H, hd]
+    k_cache: jnp.ndarray,  # [S, Hk, hd] ONE layer's slot pool
+    v_cache: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, max_pages]
+    seq_lens: jnp.ndarray,  # [B]
+    page_size: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """The ONE pallas-vs-jnp decode-attention dispatch, shared by the
+    single-mesh forward (models/llama.py) and the pipeline stage
+    (parallel/pipeline.py) so the two paths cannot drift. The pallas
+    import stays deferred: the kernel module only loads when selected."""
+    if attn_impl == "pallas":
+        from ollamamq_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention_pallas,
+        )
+
+        return paged_decode_attention_pallas(
+            q, k_cache, v_cache, page_table, seq_lens, page_size,
+            interpret=interpret,
+        )
+    return paged_decode_attention(
+        q, k_cache, v_cache, page_table, seq_lens, page_size
+    )

@@ -51,7 +51,7 @@ from ollamamq_tpu.ops.attention import (
     causal_attention,
     flat_slot_indices,
     paged_chunk_attention_blockwise,
-    paged_decode_attention,
+    paged_decode_attention_any,
 )
 from ollamamq_tpu.ops.rope import apply_rope
 from ollamamq_tpu.parallel.mesh import AXIS_PIPE, AXIS_TENSOR
@@ -351,8 +351,14 @@ def pp_forward_decode(
     page_size: int,
     mesh: Mesh,
     n_micro: Optional[int] = None,
+    attn_impl: str = "jnp",  # "jnp" reference | "pallas" ragged TPU kernel
+    interpret: bool = False,  # pallas interpret mode (CPU tests)
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Pipelined single decode step; returns (logits [B, V], caches')."""
+    """Pipelined single decode step; returns (logits [B, V], caches').
+
+    The ragged Pallas kernel runs per-device inside the shard_map stage
+    (each stage's pallas_call sees its local layer-slice caches), same
+    AOT-probe fallback discipline as the single-mesh path."""
     B = tokens.shape[0]
     pipe = mesh.shape[AXIS_PIPE]
     M = n_microbatches(B, pipe, n_micro)
@@ -373,8 +379,9 @@ def pp_forward_decode(
             def attn_and_cache(q, k, v, kcl, vcl):
                 kcl = kcl.at[ws].set(k[:, 0])
                 vcl = vcl.at[ws].set(v[:, 0])
-                attn = paged_decode_attention(
-                    q[:, 0], kcl, vcl, ptm, pos + 1, page_size
+                attn = paged_decode_attention_any(
+                    attn_impl, q[:, 0], kcl, vcl, ptm, pos + 1, page_size,
+                    interpret=interpret,
                 )
                 return attn[:, None], kcl, vcl  # [mb, 1, H_loc, hd]
 

@@ -54,8 +54,11 @@ def test_model_decode_with_pallas_impl(tiny_cfg, tiny_params):
     import ollamamq_tpu.ops.pallas.paged_attention as pa
 
     orig = pa.paged_decode_attention_pallas
-    pa_interp = functools.partial(orig, interpret=True)
-    pa.paged_decode_attention_pallas = pa_interp
+    # Force interpret even though the caller passes interpret=False
+    # explicitly (a partial's keyword would be overridden).
+    pa.paged_decode_attention_pallas = (
+        lambda *a, **k: orig(*a, **{**k, "interpret": True})
+    )
     try:
         a = kvc.PageAllocator(32, PS_, MP)
         pages = a.alloc(6)

@@ -152,6 +152,65 @@ def test_pp_engine_serves_generate_and_long_prompt():
         pp.stop()
 
 
+def test_pp2_decode_pallas_interpret_matches_reference():
+    """The ragged Pallas kernel inside the shard_map decode stage
+    (interpret mode on CPU) matches the jnp pipeline path exactly."""
+    cfg = MODEL_CONFIGS["test-tiny"]
+    mesh = make_mesh(dp=1, pp=2, tp=1)
+    params, tokens, seq_lens, kc, vc, pt = _setup(cfg)
+    logits, kc, vc = pipeline.pp_forward_prefill(
+        params, cfg, tokens, seq_lens, kc, vc, pt, PAGE_SIZE, mesh
+    )
+    next_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    ref_d, ref_kc, ref_vc = pipeline.pp_forward_decode(
+        params, cfg, next_tok, seq_lens, kc, vc, pt, PAGE_SIZE, mesh
+    )
+    pal_d, pal_kc, pal_vc = pipeline.pp_forward_decode(
+        params, cfg, next_tok, seq_lens, kc, vc, pt, PAGE_SIZE, mesh,
+        attn_impl="pallas", interpret=True,
+    )
+    np.testing.assert_allclose(pal_d, ref_d, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(_real(pal_kc), _real(ref_kc), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(_real(pal_vc), _real(ref_vc), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_dp2_x_pp2_replica_serving():
+    """dp=2 with pp=2: each ReplicaSet member owns a [1, 2, 1, 1, tp]
+    submesh and runs its own 2-stage pipeline; both replicas serve."""
+    from ollamamq_tpu.config import EngineConfig
+    from ollamamq_tpu.engine.engine import ReplicaSet, TPUEngine
+    from ollamamq_tpu.engine.request import Request
+    from ollamamq_tpu.ops.sampling import SamplingParams
+    from testutil import collect
+
+    cfg = EngineConfig(
+        model="test-tiny", max_slots=2, num_pages=32, page_size=8,
+        max_pages_per_seq=8, prefill_buckets=(16,), max_new_tokens=8,
+        decode_steps_per_iter=2, dp=2, pp=2, dtype="float32",
+    )
+    eng = TPUEngine(cfg, blocklist_path=None)
+    eng.start()
+    try:
+        rs = eng.runtimes["test-tiny"]
+        assert isinstance(rs, ReplicaSet) and len(rs.replicas) == 2
+        assert all(r._pp == 2 for r in rs.replicas)
+        tok = rs.replicas[0].tokenizer
+        reqs = []
+        for i in range(4):  # enough to land work on both replicas
+            rid = eng.core.enqueue(f"u{i}", "127.0.0.1", "test-tiny")
+            req = Request(rid, f"u{i}", "test-tiny", tok.encode(f"hi {i}"),
+                          SamplingParams(max_tokens=4))
+            eng.submit(req)
+            reqs.append(req)
+        for req in reqs:
+            items = collect(req, timeout=180)
+            assert items[-1].kind == "done", items[-1].error
+    finally:
+        eng.stop()
+
+
 def test_n_microbatches_helper():
     assert pipeline.n_microbatches(8, 4) == 4
     assert pipeline.n_microbatches(6, 4) == 3
