@@ -54,10 +54,13 @@ def main() -> int:
     p.add_argument("--long-prompt", type=int, default=0,
                    help="if >0, also time chunked prefill of a prompt this "
                         "long (should exceed the largest bucket)")
-    p.add_argument("--sweep-chunks", default="",
+    p.add_argument("--sweep-chunks", default="32,64",
                    help="comma-separated extra decode-chunk sizes to sweep "
                         "(same runtime; batch reset between legs); the "
-                        "headline number is the best leg")
+                        "headline number is the best leg. Defaults on so "
+                        "the driver's plain run self-tunes the dispatch "
+                        "amortization (tunnel RTT dominates small chunks); "
+                        "pass '' for a single-chunk run")
     p.add_argument("--embed-model", default="",
                    help="if set, also measure embedding batch throughput "
                         "on this encoder model (BASELINE config 3)")
@@ -75,6 +78,15 @@ def main() -> int:
             args.ttft_samples) < 1 or args.warmup_steps < 0
             or args.long_prompt < 0):
         _emit_error("invalid arguments: counts must be positive")
+        return 2
+    try:
+        sweep_extra = [int(c) for c in args.sweep_chunks.split(",")
+                       if c.strip()]
+    except ValueError:
+        _emit_error(f"invalid --sweep-chunks '{args.sweep_chunks}'")
+        return 2
+    if any(c < 1 for c in sweep_extra):
+        _emit_error("sweep chunks must be positive")
         return 2
 
     from ollamamq_tpu.config import MODEL_CONFIGS, EngineConfig, get_model_config
@@ -135,9 +147,17 @@ def main() -> int:
         init_done.set()
         _emit_error(f"backend init failed: {type(e).__name__}: {e}", phase="init")
         return 3
-    # Pages: prompt + generated headroom for every slot.
-    tokens_per_seq = max(args.prompt_len + args.steps + args.chunk,
-                         args.long_prompt + args.chunk)
+    # Pages: prompt + generated headroom for every slot. A leg consumes,
+    # beyond prompt + steps: one compile dispatch (chunk), timed_decode's
+    # unconditional first dispatch (chunk), warmup rounded UP to a chunk
+    # multiple (chunk - 1 over), and the final timed dispatch overshooting
+    # `steps` by up to chunk - 1 — so 4 chunks of slack on top of
+    # warmup + steps covers the worst case for the largest sweep leg.
+    max_chunk = max([args.chunk] + sweep_extra)
+    tokens_per_seq = max(
+        args.prompt_len + args.warmup_steps + args.steps + 4 * max_chunk,
+        args.long_prompt + max_chunk,
+    )
     page_size = args.page_size
     pages_per_seq = -(-tokens_per_seq // page_size) + 1
     ecfg = EngineConfig(
@@ -311,10 +331,7 @@ def main() -> int:
             raise
 
     sweep = []
-    chunks = [args.chunk] + [
-        int(c) for c in args.sweep_chunks.split(",") if c.strip()
-        and int(c) != args.chunk
-    ]
+    chunks = [args.chunk] + [c for c in sweep_extra if c != args.chunk]
     for leg_chunk in chunks:
         if leg_chunk != chunks[0]:
             active = reset_batch()
