@@ -333,17 +333,29 @@ def main() -> int:
     sweep = []
     chunks = [args.chunk] + [c for c in sweep_extra if c != args.chunk]
     for leg_chunk in chunks:
-        if leg_chunk != chunks[0]:
-            active = reset_batch()
-        done, el = timed_decode(leg_chunk)
+        # Each leg is error-contained: with the sweep on by default, a
+        # compile/device failure on a later chunk size must not discard
+        # the legs already measured (this may be a one-shot live-chip run).
+        try:
+            if leg_chunk != chunks[0]:
+                active = reset_batch()
+            done, el = timed_decode(leg_chunk)
+        except Exception as e:
+            print(f"# sweep leg chunk={leg_chunk} failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            sweep.append({"chunk": leg_chunk, "tok_per_s": 0.0, "steps": 0,
+                          "elapsed_s": 0.0, "step_ms": None,
+                          "error": f"{type(e).__name__}: {e}"})
+            continue
         leg_tok_s = active * done / el if el > 0 else 0.0
         sweep.append({"chunk": leg_chunk, "tok_per_s": round(leg_tok_s, 1),
                       "steps": done, "elapsed_s": el,
                       "step_ms": round(el / done * 1e3, 3) if done else None})
     best = max(sweep, key=lambda s: s["tok_per_s"])
     if best["steps"] == 0:
-        _emit_error("decode made no progress (page budget too small for "
-                    "the prompt/steps requested?)", device=str(dev))
+        _emit_error("decode made no progress on any sweep leg (page "
+                    "budget too small, or every leg failed?)",
+                    device=str(dev), sweep=sweep)
         return 5
     done_steps, elapsed = best["steps"], best.pop("elapsed_s")
     for leg in sweep:

@@ -5,11 +5,12 @@ cross-host tensor parallelism is numerically transparent."""
 
 import json
 import os
-import socket
 import subprocess
 import sys
 
 import pytest
+
+from testutil import free_port
 
 _SCRIPT = r"""
 import json, os, sys
@@ -77,16 +78,9 @@ else:
 """
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
 
 def test_spmd_two_process_serving(tmp_path):
-    port = _free_port()
+    port = free_port()
     script = tmp_path / "spmd_child.py"
     script.write_text(_SCRIPT)
     env = dict(os.environ)

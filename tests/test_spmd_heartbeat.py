@@ -11,12 +11,13 @@ loudly within seconds.
 
 import json
 import os
-import socket
 import subprocess
 import sys
 import time
 
 import pytest
+
+from testutil import free_port
 
 from ollamamq_tpu.engine.spmd import _HeartbeatMonitor
 
@@ -110,16 +111,9 @@ else:
 """
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
 
 def test_spmd_dead_worker_fails_requests_fast(tmp_path):
-    port = _free_port()
+    port = free_port()
     script = tmp_path / "hb_child.py"
     script.write_text(_DEATH_SCRIPT)
     env = dict(os.environ)

@@ -1,6 +1,8 @@
-"""SPMD sequence-parallel prefill: 2 CPU processes, mesh seq axis spanning
-both — a long prompt takes the OP_PREFILL_SP broadcast path and the
-generated tokens equal a single-process run."""
+"""Pipeline parallelism ACROSS hosts: 2 CPU processes, global mesh pp=2
+with one stage per process; the primary serves a request while the worker
+replays its dispatches (the GPipe shard_map's ppermute handoffs cross the
+process boundary). Greedy tokens must equal a plain single-device run —
+cross-host pipeline parallelism is numerically transparent."""
 
 import json
 import os
@@ -22,51 +24,55 @@ jax.distributed.initialize(coordinator_address=f"localhost:{port}",
                            num_processes=2, process_id=pid)
 assert jax.device_count() == 2
 
-from ollamamq_tpu.config import EngineConfig
+from ollamamq_tpu.config import MODEL_CONFIGS, EngineConfig
 from ollamamq_tpu.parallel.mesh import make_mesh
 import jax.numpy as jnp
 
-mesh = make_mesh(dp=1, sp=2, tp=1)
-ecfg = EngineConfig(model="test-tiny", max_slots=2, num_pages=64, page_size=8,
-                    max_pages_per_seq=16, prefill_buckets=(16,),
-                    decode_steps_per_iter=2, sp=2)
+mesh = make_mesh(dp=1, sp=1, tp=1, pp=2)  # one pipeline stage per host
+ecfg = EngineConfig(model="test-tiny", max_slots=2, num_pages=32, page_size=8,
+                    max_pages_per_seq=8, prefill_buckets=(16,),
+                    decode_steps_per_iter=2, pp=2)
+MODELS = {"test-tiny": None}
 
 if pid == 0:
     from ollamamq_tpu.engine.spmd import SPMDEngine
     from ollamamq_tpu.ops.sampling import SamplingParams
 
-    eng = SPMDEngine(ecfg, models={"test-tiny": None}, blocklist_path=None,
+    eng = SPMDEngine(ecfg, models=MODELS, blocklist_path=None,
                      mesh=mesh, dtype=jnp.float32)
     eng.start()
-    rt = eng.runtimes["test-tiny"]
-    assert rt._sp, "seq axis not detected"
-    tok = rt.tokenizer
-    prompt = tok.encode("sequence parallel spmd " * 3)  # ~70 > bucket 16
-    req = eng.enqueue_request("u", "", "test-tiny", prompt_tokens=prompt,
-                              sampling=SamplingParams(max_tokens=5))
     import time
+
+    rt = eng.runtimes["test-tiny"]
+    assert rt._pp == 2, rt._pp
+    tok = rt.tokenizer
+    req = eng.enqueue_request("u", "", "test-tiny",
+                              prompt_tokens=tok.encode("pp across hosts"),
+                              sampling=SamplingParams(max_tokens=6))
     deadline = time.monotonic() + 300
+    item = None
     while time.monotonic() < deadline:
         item = req.stream.get(timeout=0.5)
         if item and item.kind in ("done", "error"):
             break
-    used_sp = any(isinstance(k, tuple) and k[0] == "sp"
-                  for k in rt._prefill_jits)
     eng.stop()
-    print("RESULT " + json.dumps({"tokens": req.generated_ids,
-                                  "used_sp": used_sp}), flush=True)
+    print("RESULT " + json.dumps({
+        "kind": item.kind if item else "timeout",
+        "error": getattr(item, "error", "") if item else "",
+        "tokens": req.generated_ids,
+    }), flush=True)
 else:
     from ollamamq_tpu.engine.spmd import run_worker
 
-    steps = run_worker({"test-tiny": None}, ecfg, mesh, dtype=jnp.float32)
+    steps = run_worker(MODELS, ecfg, mesh, dtype=jnp.float32)
     print("RESULT " + json.dumps({"steps": steps}), flush=True)
 """
 
 
 
-def test_spmd_sp_prefill_two_processes(tmp_path):
+def test_spmd_pipeline_parallel_across_processes(tmp_path):
     port = free_port()
-    script = tmp_path / "spmd_sp_child.py"
+    script = tmp_path / "spmd_pp_child.py"
     script.write_text(_SCRIPT)
     env = dict(os.environ)
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -84,7 +90,7 @@ def test_spmd_sp_prefill_two_processes(tmp_path):
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
-            pytest.fail("SPMD SP processes hung")
+            pytest.fail("SPMD pp processes hung")
         assert p.returncode == 0, f"child failed:\n{err[-2000:]}"
         outs.append(out)
 
@@ -94,37 +100,41 @@ def test_spmd_sp_prefill_two_processes(tmp_path):
     worker = json.loads(
         [l for l in outs[1].splitlines() if l.startswith("RESULT ")][0][7:]
     )
-    assert primary["used_sp"], "long prompt did not take the SP path"
-    assert worker["steps"] >= 2  # sp prefill + decode dispatches
+    assert primary["kind"] == "done", primary
+    assert worker["steps"] >= 2  # prefill + decode dispatches replayed
     assert len(primary["tokens"]) >= 1
 
-    # Single-process reference (same seed/config) must match exactly.
+    # Cross-host pp must be numerically transparent: same greedy tokens as
+    # a plain single-device engine (pipeline exactness is schedule-only).
     import time
 
     import jax.numpy as jnp
 
     from ollamamq_tpu.config import EngineConfig
     from ollamamq_tpu.engine.engine import TPUEngine
+    from ollamamq_tpu.engine.request import Request
     from ollamamq_tpu.ops.sampling import SamplingParams
 
     eng = TPUEngine(
-        EngineConfig(model="test-tiny", max_slots=2, num_pages=64,
-                     page_size=8, max_pages_per_seq=16, prefill_buckets=(16,),
+        EngineConfig(model="test-tiny", max_slots=2, num_pages=32,
+                     page_size=8, max_pages_per_seq=8, prefill_buckets=(16,),
                      decode_steps_per_iter=2),
         models={"test-tiny": None}, blocklist_path=None, dtype=jnp.float32,
     )
     eng.start()
     try:
         tok = eng.runtimes["test-tiny"].tokenizer
-        req = eng.enqueue_request(
-            "u", "", "test-tiny",
-            prompt_tokens=tok.encode("sequence parallel spmd " * 3),
-            sampling=SamplingParams(max_tokens=5))
+        rid = eng.core.enqueue("u", "127.0.0.1", "test-tiny")
+        req = Request(rid, "u", "test-tiny", tok.encode("pp across hosts"),
+                      SamplingParams(max_tokens=6))
+        eng.submit(req)
         deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             item = req.stream.get(timeout=0.5)
             if item and item.kind in ("done", "error"):
                 break
-        assert req.generated_ids == primary["tokens"]
     finally:
         eng.stop()
+    assert req.generated_ids == primary["tokens"], (
+        req.generated_ids, primary["tokens"]
+    )

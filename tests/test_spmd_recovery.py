@@ -15,11 +15,12 @@ Two 2-process CPU deployments:
 
 import json
 import os
-import socket
 import subprocess
 import sys
 
 import pytest
+
+from testutil import free_port
 
 _DESYNC_SCRIPT = r"""
 import json, os, sys
@@ -188,16 +189,9 @@ else:
 """
 
 
-def _free_port():
-    s = socket.socket()
-    s.bind(("", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
 
 def _launch(script_text, tmp_path, timeout=540):
-    port = _free_port()
+    port = free_port()
     script = tmp_path / "spmd_child.py"
     script.write_text(script_text)
     env = dict(os.environ)

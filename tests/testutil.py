@@ -15,3 +15,15 @@ def collect(req, timeout=120):
         if item.kind in ("done", "error"):
             return items
     raise TimeoutError(f"request {req.req_id} did not finish; got {items}")
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port (close-then-rebind race is acceptable
+    for the jax.distributed coordinator in these short-lived tests)."""
+    import socket
+
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
