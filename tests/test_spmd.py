@@ -3,14 +3,7 @@ both, primary serves a request while the worker replays its dispatches.
 The generated tokens must equal a single-process run (same seed) — i.e.
 cross-host tensor parallelism is numerically transparent."""
 
-import json
-import os
-import subprocess
-import sys
-
-import pytest
-
-from testutil import free_port
+from testutil import run_two_process
 
 _SCRIPT = r"""
 import json, os, sys
@@ -77,38 +70,8 @@ else:
     print("RESULT " + json.dumps({"steps": steps}), flush=True)
 """
 
-
-
 def test_spmd_two_process_serving(tmp_path):
-    port = free_port()
-    script = tmp_path / "spmd_child.py"
-    script.write_text(_SCRIPT)
-    env = dict(os.environ)
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [
-        subprocess.Popen([sys.executable, str(script), str(pid), str(port)],
-                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                         text=True, env=env)
-        for pid in (0, 1)
-    ]
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=540)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("SPMD processes hung")
-        assert p.returncode == 0, f"child failed:\n{err[-2000:]}"
-        outs.append(out)
-
-    primary = json.loads(
-        [l for l in outs[0].splitlines() if l.startswith("RESULT ")][0][7:]
-    )
-    worker = json.loads(
-        [l for l in outs[1].splitlines() if l.startswith("RESULT ")][0][7:]
-    )
+    primary, worker = run_two_process(_SCRIPT, tmp_path)
     assert worker["steps"] >= 3  # prefill + decode(s) + encode dispatch
     assert len(primary["tokens"]) >= 1
     assert primary["embed_ok"] and primary["embed_dim"] > 0
@@ -116,7 +79,6 @@ def test_spmd_two_process_serving(tmp_path):
     # Single-process reference with the same seed/config must match exactly.
     from ollamamq_tpu.config import EngineConfig
     from ollamamq_tpu.engine.engine import TPUEngine
-    from ollamamq_tpu.engine.request import Request
     from ollamamq_tpu.ops.sampling import SamplingParams
     import jax.numpy as jnp
     import time

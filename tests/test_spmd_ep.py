@@ -1,8 +1,7 @@
-"""Pipeline parallelism ACROSS hosts: 2 CPU processes, global mesh pp=2
-with one stage per process; the primary serves a request while the worker
-replays its dispatches (the GPipe shard_map's ppermute handoffs cross the
-process boundary). Greedy tokens must equal a plain single-device run —
-cross-host pipeline parallelism is numerically transparent."""
+"""Expert parallelism ACROSS hosts: 2 CPU processes, global mesh ep=2 —
+each process owns half the experts of the MoE model; GSPMD inserts the
+expert all-to-all across the process boundary. Greedy tokens must equal a
+plain single-device run (EP is layout-only)."""
 
 from testutil import run_two_process, single_device_greedy_tokens
 
@@ -21,11 +20,11 @@ from ollamamq_tpu.config import EngineConfig
 from ollamamq_tpu.parallel.mesh import make_mesh
 import jax.numpy as jnp
 
-mesh = make_mesh(dp=1, sp=1, tp=1, pp=2)  # one pipeline stage per host
-ecfg = EngineConfig(model="test-tiny", max_slots=2, num_pages=32, page_size=8,
-                    max_pages_per_seq=8, prefill_buckets=(16,),
-                    decode_steps_per_iter=2, pp=2)
-MODELS = {"test-tiny": None}
+mesh = make_mesh(dp=1, sp=1, tp=1, ep=2)  # half the experts per host
+ecfg = EngineConfig(model="test-tiny-moe", max_slots=2, num_pages=32,
+                    page_size=8, max_pages_per_seq=8, prefill_buckets=(16,),
+                    decode_steps_per_iter=2, ep=2)
+MODELS = {"test-tiny-moe": None}
 
 if pid == 0:
     from ollamamq_tpu.engine.spmd import SPMDEngine
@@ -36,11 +35,9 @@ if pid == 0:
     eng.start()
     import time
 
-    rt = eng.runtimes["test-tiny"]
-    assert rt._pp == 2, rt._pp
-    tok = rt.tokenizer
-    req = eng.enqueue_request("u", "", "test-tiny",
-                              prompt_tokens=tok.encode("pp across hosts"),
+    tok = eng.runtimes["test-tiny-moe"].tokenizer
+    req = eng.enqueue_request("u", "", "test-tiny-moe",
+                              prompt_tokens=tok.encode("experts apart"),
                               sampling=SamplingParams(max_tokens=6))
     deadline = time.monotonic() + 300
     item = None
@@ -62,12 +59,11 @@ else:
 """
 
 
-def test_spmd_pipeline_parallel_across_processes(tmp_path):
+def test_spmd_expert_parallel_across_processes(tmp_path):
     primary, worker = run_two_process(_SCRIPT, tmp_path)
     assert primary["kind"] == "done", primary
     assert worker["steps"] >= 2  # prefill + decode dispatches replayed
     assert len(primary["tokens"]) >= 1
-    # Cross-host pp must be numerically transparent: same greedy tokens as
-    # a plain single-device engine (pipeline exactness is schedule-only).
+    # EP across hosts must be numerically transparent.
     assert single_device_greedy_tokens(
-        "test-tiny", "pp across hosts") == primary["tokens"]
+        "test-tiny-moe", "experts apart") == primary["tokens"]

@@ -2,14 +2,7 @@
 both — a long prompt takes the OP_PREFILL_SP broadcast path and the
 generated tokens equal a single-process run."""
 
-import json
-import os
-import subprocess
-import sys
-
-import pytest
-
-from testutil import free_port
+from testutil import run_two_process
 
 _SCRIPT = r"""
 import json, os, sys
@@ -62,38 +55,8 @@ else:
     print("RESULT " + json.dumps({"steps": steps}), flush=True)
 """
 
-
-
 def test_spmd_sp_prefill_two_processes(tmp_path):
-    port = free_port()
-    script = tmp_path / "spmd_sp_child.py"
-    script.write_text(_SCRIPT)
-    env = dict(os.environ)
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [
-        subprocess.Popen([sys.executable, str(script), str(pid), str(port)],
-                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                         text=True, env=env)
-        for pid in (0, 1)
-    ]
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=540)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("SPMD SP processes hung")
-        assert p.returncode == 0, f"child failed:\n{err[-2000:]}"
-        outs.append(out)
-
-    primary = json.loads(
-        [l for l in outs[0].splitlines() if l.startswith("RESULT ")][0][7:]
-    )
-    worker = json.loads(
-        [l for l in outs[1].splitlines() if l.startswith("RESULT ")][0][7:]
-    )
+    primary, worker = run_two_process(_SCRIPT, tmp_path)
     assert primary["used_sp"], "long prompt did not take the SP path"
     assert worker["steps"] >= 2  # sp prefill + decode dispatches
     assert len(primary["tokens"]) >= 1

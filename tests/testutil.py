@@ -27,3 +27,79 @@ def free_port() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def run_two_process(script_text, tmp_path, timeout=540):
+    """Launch a 2-process jax.distributed child script (argv: pid, port)
+    and return the parsed RESULT json of each process. THE harness for
+    every cross-host SPMD test — write-script/Popen/kill-on-timeout/parse
+    lives here once."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    import pytest
+
+    script = tmp_path / "spmd_child.py"
+    script.write_text(script_text)
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    port = free_port()
+    procs = [
+        subprocess.Popen([sys.executable, str(script), str(pid), str(port)],
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True, env=env)
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("SPMD processes hung")
+        assert p.returncode == 0, f"child failed:\n{err[-2000:]}"
+        outs.append(out)
+    return [
+        json.loads([l for l in o.splitlines()
+                    if l.startswith("RESULT ")][0][7:])
+        for o in outs
+    ]
+
+
+def single_device_greedy_tokens(model, prompt, max_tokens=6, **ecfg_kw):
+    """Generated ids from a plain single-device engine — the numeric
+    reference every cross-host parallelism test compares against."""
+    import time
+
+    import jax.numpy as jnp
+
+    from ollamamq_tpu.config import EngineConfig
+    from ollamamq_tpu.engine.engine import TPUEngine
+    from ollamamq_tpu.engine.request import Request
+    from ollamamq_tpu.ops.sampling import SamplingParams
+
+    defaults = dict(model=model, max_slots=2, num_pages=32, page_size=8,
+                    max_pages_per_seq=8, prefill_buckets=(16,),
+                    decode_steps_per_iter=2)
+    defaults.update(ecfg_kw)
+    eng = TPUEngine(EngineConfig(**defaults), models={model: None},
+                    blocklist_path=None, dtype=jnp.float32)
+    eng.start()
+    try:
+        tok = eng.runtimes[model].tokenizer
+        rid = eng.core.enqueue("u", "127.0.0.1", model)
+        req = Request(rid, "u", model, tok.encode(prompt),
+                      SamplingParams(max_tokens=max_tokens))
+        eng.submit(req)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            item = req.stream.get(timeout=0.5)
+            if item and item.kind in ("done", "error"):
+                break
+    finally:
+        eng.stop()
+    return req.generated_ids
