@@ -35,6 +35,15 @@ class Fairness(enum.IntEnum):
 
 def _ensure_built() -> str:
     with _BUILD_LOCK:
+        if not os.path.isdir(_CPP_DIR):
+            # A plain `pip install .` copies only the python package to
+            # site-packages; the native core's sources stay in the repo.
+            raise RuntimeError(
+                "native scheduler core sources not found at "
+                f"{_CPP_DIR}: ollamamq-tpu must run from a checkout "
+                "(`pip install -e .`) or the Docker image, which builds "
+                "cpp/libmqcore.so in stage 1"
+            )
         sources = [
             os.path.join(_CPP_DIR, f)
             for f in os.listdir(_CPP_DIR)
