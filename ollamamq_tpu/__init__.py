@@ -12,7 +12,7 @@ Reference capability map: /root/reference/src/{main,dispatcher,tui}.rs
 (studied for behavior only; architecture here is TPU-first).
 """
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
 
 
 def __getattr__(name):
