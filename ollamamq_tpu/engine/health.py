@@ -64,7 +64,6 @@ class HealthMonitor:
 
         def go():
             try:
-                import jax
                 import jax.numpy as jnp
 
                 x = jnp.ones((8, 8))

@@ -53,9 +53,9 @@ def test_2k_prompt_chunked_serving_matches_oneshot():
         assert items[-1].kind == "done", items[-1].error
         engine_ids = req.generated_ids
     finally:
+        MODEL_CONFIGS.pop("test-tiny-long", None)
         if eng is not None:
             eng.stop()
-        MODEL_CONFIGS.pop("test-tiny-long", None)
     assert len(engine_ids) == GEN
 
     # Reference: one-shot full-sequence prefill + stepwise greedy decode
