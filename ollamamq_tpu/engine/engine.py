@@ -2097,6 +2097,9 @@ class TPUEngine:
         return {
             "runtimes": runtime_stats,
             "chips": chips,
+            # Mesh layout so operators can see WHICH parallelism the pod
+            # is running (axis name -> size), not just how many chips.
+            "mesh": dict(self.mesh.shape) if self.mesh is not None else None,
             "hbm_used_bytes": hbm_used,
             "hbm_total_bytes": hbm_total,
             "devices": [str(d) for d in jax.devices()],

@@ -132,6 +132,8 @@ def test_pp_engine_serves_generate_and_long_prompt():
         # models pp targets), so the kind-gate must reject it cleanly.
         assert pp.runtimes["test-tiny"].SERVES == ("generate",)
         assert ref.runtimes["test-tiny"].SERVES == ("generate", "embed")
+        # /metrics reports the mesh layout (axis -> size).
+        assert pp.stats()["mesh"]["pipe"] == 2
         # Short prompt (bucketed prefill) and a prompt past the largest
         # bucket (chunked prefill), both compared greedy-vs-greedy.
         for prompt in ("hello pipeline world", "long " * 20):
