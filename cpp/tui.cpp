@@ -312,6 +312,24 @@ struct Tui {
       std::snprintf(l, sizeof l, " %s  HBM %s", dev.c_str(),
                     human_bytes(hbm_used).c_str());
     out.push_back(std::string(CYAN) + l + RST);
+    /* Throughput + MFU: the "is the pod earning its keep" line. MFU is
+     * the max over runtimes (fraction 0..1 from the engine's analytic
+     * FLOPs model over chip peak); 0 renders as "--" (unknown peak, e.g.
+     * CPU meshes, or no decode step yet). */
+    double mfu = 0;
+    auto models_mfu = stats->get("models");
+    if (models_mfu)
+      for (auto &m : models_mfu->arr) {
+        double v = m->get("mfu") ? m->get("mfu")->as_num() : 0;
+        if (v > mfu) mfu = v;
+      }
+    if (mfu > 0)
+      std::snprintf(l, sizeof l, " throughput %.0f tok/s   MFU %.2f%%",
+                    tok_rate > 0 ? tok_rate : 0.0, mfu * 100.0);
+    else
+      std::snprintf(l, sizeof l, " throughput %.0f tok/s   MFU --",
+                    tok_rate > 0 ? tok_rate : 0.0);
+    out.push_back(std::string(CYAN) + l + RST);
     /* One row PER chip (pod-wide under SPMD): the north star's "per-chip
      * HBM occupancy" — a v5e-16 must not show chip 0 for the pod. */
     auto chips = stats->get("chips");
@@ -328,6 +346,16 @@ struct Tui {
         long long proc = c->get("process") ? c->get("process")->as_int() : 0;
         double cu = c->get("hbm_used") ? c->get("hbm_used")->as_num() : 0;
         double ct = c->get("hbm_total") ? c->get("hbm_total")->as_num() : 0;
+        /* Backend without memory_stats (CPU): say "n/a", never a fake
+         * 0-byte HBM reading. Missing key = legacy row = assume real. */
+        auto ms = c->get("memory_stats");
+        if (ms && ms->type == mj::Value::BOOL && !ms->b) {
+          std::snprintf(l, sizeof l, "  chip %lld (host %lld)  HBM n/a",
+                        id, proc);
+          out.push_back(std::string(DIM) + l + RST);
+          ++shown;
+          continue;
+        }
         if (ct > 0)
           std::snprintf(l, sizeof l, "  chip %lld (host %lld)  %s/%s (%.0f%%)",
                         id, proc, human_bytes(cu).c_str(),

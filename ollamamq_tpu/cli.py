@@ -66,6 +66,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="GPipe microbatches per pp dispatch (0 = one per "
                         "stage; sweep on hardware — prefill wants more, "
                         "weight-bound decode may want fewer)")
+    # Telemetry.
+    p.add_argument("--metrics-buckets", default="",
+                   help="comma-separated upper bounds (ms) for the latency "
+                        "histograms on /metrics (ttft/tpot/step/prefill); "
+                        "default is a 1ms..30s ladder")
+    p.add_argument("--trace-ring", type=int, default=512,
+                   help="finished request traces kept for /debug/trace "
+                        "(Chrome trace-event export)")
     p.add_argument("--token-fairness", action="store_true",
                    help="fair-share by served tokens instead of request count")
     p.add_argument("--spmd", action="store_true",
@@ -112,6 +120,21 @@ def main(argv=None) -> int:
     from ollamamq_tpu.config import EngineConfig
     from ollamamq_tpu.core import Fairness
 
+    if args.metrics_buckets:
+        from ollamamq_tpu.telemetry import schema as tm_schema
+
+        try:
+            bounds = tuple(float(b) for b in args.metrics_buckets.split(",")
+                           if b.strip())
+        except ValueError:
+            log.error("invalid --metrics-buckets %r (want comma-separated "
+                      "numbers)", args.metrics_buckets)
+            return 2
+        if not bounds:
+            log.error("--metrics-buckets must name at least one bound")
+            return 2
+        tm_schema.configure_latency_buckets(bounds)
+
     # Multi-host control plane: no-op unless JAX_COORDINATOR_ADDRESS /
     # JAX_NUM_PROCESSES are set (or a TPU pod auto-detects). After this,
     # jax.devices() spans all hosts and tp=-1 shards over the whole pod.
@@ -141,6 +164,7 @@ def main(argv=None) -> int:
         pp=args.pp,
         ep=args.ep,
         pp_microbatches=args.pp_microbatches or None,
+        trace_ring=args.trace_ring,
     )
     fairness = Fairness.TOKENS if args.token_fairness else Fairness.REQUESTS
 

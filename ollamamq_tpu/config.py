@@ -238,6 +238,9 @@ class EngineConfig:
     pp_microbatches: Optional[int] = None
     dtype: str = "bfloat16"
     seed: int = 0
+    # Telemetry: finished request traces kept for GET /debug/trace
+    # (Chrome trace-event export); in-flight traces are always exported.
+    trace_ring: int = 512
 
     @property
     def max_context(self) -> int:

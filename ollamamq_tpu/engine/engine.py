@@ -51,6 +51,9 @@ from ollamamq_tpu.parallel import pipeline
 from ollamamq_tpu.parallel.mesh import (make_mesh, replica_submesh,
                                         validate_tp_for_model)
 from ollamamq_tpu.parallel.sharding import kv_cache_spec, shard_params
+from ollamamq_tpu.telemetry import mfu as mfu_model
+from ollamamq_tpu.telemetry import schema as tm
+from ollamamq_tpu.telemetry.tracing import DECODE_EVENT_EVERY, Tracer
 
 log = logging.getLogger("ollamamq.engine")
 
@@ -82,16 +85,20 @@ def per_chip_stats() -> List[dict]:
     out = []
     try:
         for d in jax.local_devices():
+            # memory_stats=False marks a backend that doesn't report HBM
+            # (CPU): /metrics omits the series and the TUI renders "n/a"
+            # instead of a fake 0-byte reading.
             row = {"device": str(d), "id": int(d.id),
                    "process": int(getattr(d, "process_index", 0)),
-                   "hbm_used": 0, "hbm_total": 0}
+                   "hbm_used": 0, "hbm_total": 0, "memory_stats": False}
             try:
                 ms = d.memory_stats()
                 if ms:
                     row["hbm_used"] = int(ms.get("bytes_in_use", 0))
                     row["hbm_total"] = int(ms.get("bytes_limit", 0) or 0)
+                    row["memory_stats"] = True
             except Exception:
-                pass  # backend without memory_stats (CPU): zeros
+                pass
             out.append(row)
     except Exception:
         pass
@@ -141,6 +148,8 @@ def serve_embed_batch(rt, core: "MQCore", pending, max_len: int,
         batch.append(req)
     if not batch:
         return False
+    for r in batch:
+        r.trace_event("embed_batch", tokens=len(r.prompt_tokens))
     longest = max(len(r.prompt_tokens) for r in batch)
     bucket = 32
     while bucket < longest:
@@ -349,6 +358,35 @@ class ModelRuntime:
         self.tokens_generated = 0
         self.ttft_window: collections.deque = collections.deque(maxlen=512)
         self.step_window: collections.deque = collections.deque(maxlen=512)
+        # Registry handles resolved once (child lookup is a dict hit, but
+        # the hot path shouldn't even pay that).
+        self._tm_ttft = tm.TTFT_MS.labels(model=name)
+        self._tm_tpot = tm.TPOT_MS.labels(model=name)
+        self._tm_step = tm.STEP_LATENCY_MS.labels(model=name)
+        self._tm_prefill = tm.PREFILL_LATENCY_MS.labels(model=name)
+        self._tm_occupancy = tm.BATCH_OCCUPANCY.labels(model=name)
+        self._tm_pages = tm.KV_PAGES_USED.labels(model=name)
+        self._tm_page_util = tm.KV_PAGE_UTILIZATION.labels(model=name)
+        self._tm_mfu = tm.MFU.labels(model=name)
+        self._tm_tokens = tm.TOKENS_GENERATED_TOTAL.labels(model=name)
+        self._tm_prompt_tokens = tm.PROMPT_TOKENS_TOTAL.labels(model=name)
+        # MFU accounting: analytic FLOPs/token (models/llama config) over
+        # this runtime's share of chip peak. Unknown accelerators (CPU
+        # meshes) publish 0, never a made-up peak.
+        try:
+            kind = jax.local_devices()[0].device_kind
+        except Exception:
+            kind = ""
+        self.peak_flops = mfu_model.peak_flops_per_chip(kind)
+        self.n_chips = int(mesh.size) if mesh is not None else 1
+        self.mfu = 0.0
+        # FLOPs model on the PRISTINE config: the replicated-group KV
+        # rewrite above duplicates KV heads as a sharding layout trick —
+        # it adds no real math.
+        tm.FLOPS_PER_TOKEN.labels(model=name).set(
+            mfu_model.flops_per_token(self._orig_cfg))
+        self._tm_occupancy.set(0.0)
+        self._tm_mfu.set(0.0)
         self.param_bytes = sum(
             x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(params)
         )
@@ -629,6 +667,7 @@ class ModelRuntime:
         tokens = np.zeros((1, T), np.int32)
         tokens[0, :n] = req.prompt_tokens
         self.inflight_prefill = [req]  # cancel() must still find it
+        req.trace_event("prefill", mode="sp", tokens=n)
         t0 = time.monotonic()
         try:
             tok, self.kc, self.vc, self.recent = self._dispatch_prefill_sp(
@@ -659,6 +698,7 @@ class ModelRuntime:
         finally:
             self.inflight_prefill = []
         self.prefill_latency_ms = (time.monotonic() - t0) * 1e3
+        self._tm_prefill.observe(self.prefill_latency_ms)
         self._install_slot(slot, req, n, int(np.asarray(tok)[0]), core)
 
     def _get_decode_jit(self, k_steps: int, flags=(True, True, True)):
@@ -767,6 +807,10 @@ class ModelRuntime:
         if not req.stats.first_token_at:
             req.stats.first_token_at = time.monotonic()
             self.ttft_window.append(req.stats.ttft_ms)
+            self._tm_ttft.observe(req.stats.ttft_ms)
+            req.trace_event("first_token", ttft_ms=round(req.stats.ttft_ms, 3))
+        elif len(req.generated_ids) % DECODE_EVENT_EVERY == 0:
+            req.trace_event("decode", tokens=len(req.generated_ids))
         text = req._inc_decode(tok)
         chunk = req.emit_text(text) if text else ""
         if chunk is None:  # stop string fired: suppress held-back text
@@ -904,6 +948,8 @@ class ModelRuntime:
             slot_ids[i] = slot
             pt_rows[i] = self.page_table[slot]
         self.inflight_prefill = [req for req, *_ in batch]
+        for req, _, _, n in batch:
+            req.trace_event("prefill", bucket=bucket, tokens=n)
         t0 = time.monotonic()
         try:
             toks, self.kc, self.vc, self.recent = self._dispatch_prefill(
@@ -926,6 +972,7 @@ class ModelRuntime:
         finally:
             self.inflight_prefill = []
         self.prefill_latency_ms = (time.monotonic() - t0) * 1e3
+        self._tm_prefill.observe(self.prefill_latency_ms)
 
         for i, (req, slot, _, n) in enumerate(batch):
             self._install_slot(slot, req, n, int(toks[i]), core)
@@ -947,6 +994,7 @@ class ModelRuntime:
         """Activate a freshly prefilled request in its decode slot and emit
         the first sampled token."""
         self.slot_req[slot] = req
+        self._tm_prompt_tokens.inc(n)
         self.seq_lens[slot] = n
         self.temp[slot] = req.sampling.temperature
         self.top_k[slot] = req.sampling.top_k
@@ -985,6 +1033,7 @@ class ModelRuntime:
         cl = len(piece)
         tokens = np.zeros((1, largest), np.int32)
         tokens[0, :cl] = piece
+        req.trace_event("prefill_chunk", pos=chunk_start, tokens=cl)
         t0 = time.monotonic()
         is_final = 1 if chunk_start + cl >= n else 0
         tok, self.kc, self.vc, self.recent = self._dispatch_chunk(
@@ -1002,6 +1051,7 @@ class ModelRuntime:
             self._next_key(),
         )
         self.prefill_latency_ms = (time.monotonic() - t0) * 1e3
+        self._tm_prefill.observe(self.prefill_latency_ms)
         req._chunk_pos = chunk_start + cl
         if req._chunk_pos < n:
             return True  # more chunks next tick
@@ -1105,10 +1155,18 @@ class ModelRuntime:
         chunk finished during that overlap reports (correctly) near-zero
         marginal step cost. Strictly an under- never an over-estimate."""
         toks_dev, active, k_steps, _dispatch_t0 = handle
+        # Mean context BEFORE the emit loop advances seq_lens: feeds the
+        # attention term of the per-step FLOPs model.
+        mean_ctx = float(np.mean([self.seq_lens[i] for i in active]))
         t_block = time.monotonic()
         toks = np.asarray(toks_dev)  # [K, S] — blocks until the chunk is done
-        self.step_latency_ms = (time.monotonic() - t_block) * 1e3 / k_steps
+        t_done = time.monotonic()
+        self.step_latency_ms = (t_done - t_block) * 1e3 / k_steps
         self.step_window.append(self.step_latency_ms)
+        self._tm_step.observe(self.step_latency_ms)
+        # TPOT: every active slot gains one token per step, so step
+        # latency IS time-per-output-token for each stream in the batch.
+        self._tm_tpot.observe(self.step_latency_ms)
 
         emitted = 0
         for k in range(k_steps):
@@ -1121,6 +1179,23 @@ class ModelRuntime:
                 emitted += 1
                 if self._emit_token(i, tok, core):
                     self.last_tokens[i] = tok
+
+        # Per-step engine telemetry: occupancy, KV-page pressure, MFU.
+        # Wall time is dispatch->collect-done — the device-side span of
+        # this chunk (an over-estimate under host overlap, so the MFU it
+        # yields is conservative, never flattering).
+        self._tm_tokens.inc(emitted)
+        self._tm_occupancy.set(len(active) / max(1, self.ecfg.max_slots))
+        self._tm_pages.set(self.alloc.used_pages)
+        self._tm_page_util.set(
+            self.alloc.used_pages / max(1, self.alloc.num_pages - 1))
+        wall = t_done - _dispatch_t0
+        # _orig_cfg, not self.cfg: replicated-group KV inflates kv_dim as
+        # a layout trick, not real FLOPs.
+        self.mfu = mfu_model.mfu(self._orig_cfg, emitted, wall,
+                                 self.peak_flops, n_chips=self.n_chips,
+                                 context_len=mean_ctx)
+        self._tm_mfu.set(self.mfu)
         return emitted
 
     def check_cancellations(self, core: MQCore) -> None:
@@ -1204,6 +1279,7 @@ class ModelRuntime:
             "ttft_p50_ms": pctl(self.ttft_window, 0.50),
             "ttft_p99_ms": pctl(self.ttft_window, 0.99),
             "tokens_generated": self.tokens_generated,
+            "mfu": round(self.mfu, 4),
             "param_bytes": self.param_bytes,
             "kv_bytes": self.kv_bytes,
         }
@@ -1292,6 +1368,7 @@ class EncoderRuntime:
             "step_latency_ms": round(self.step_latency_ms, 3),
             "prefill_latency_ms": 0.0,
             "tokens_generated": self.tokens_generated,
+            "mfu": 0.0,  # encoders don't publish decode-step MFU
             "param_bytes": self.param_bytes,
             "kv_bytes": self.kv_bytes,
         }
@@ -1419,8 +1496,9 @@ class ReplicaSet:
                     "param_bytes", "kv_bytes"):
             agg[key] = sum(p[key] for p in per)
         for key in ("step_latency_ms", "step_p50_ms", "step_p99_ms",
-                    "prefill_latency_ms", "ttft_p50_ms", "ttft_p99_ms"):
-            agg[key] = max(p[key] for p in per)
+                    "prefill_latency_ms", "ttft_p50_ms", "ttft_p99_ms",
+                    "mfu"):
+            agg[key] = max(p.get(key, 0.0) for p in per)
         agg["replicas"] = len(per)
         return agg
 
@@ -1467,6 +1545,9 @@ class TPUEngine:
         self._engine_calls: collections.deque = collections.deque()
         self.health = None
         self.started_at = time.time()
+        # Request-lifecycle tracing: bounded ring of finished traces plus
+        # the in-flight table, exported at GET /debug/trace.
+        self.tracer = Tracer(capacity=engine_cfg.trace_ring)
         # CPU-gloo can't run two cross-host computations concurrently: XLA's
         # CPU thread pool executes them in nondeterministic order and their
         # collective ops interleave differently per process on the shared
@@ -1540,6 +1621,7 @@ class TPUEngine:
             )
             req = Request(rid, user, model, prompt_tokens or [], sampling,
                           kind=kind, raw_prompt=raw_prompt)
+            req.trace = self.tracer.begin(rid, user, model, kind=kind)
             self.pending[rid] = req
         self.notify()
         return req
@@ -1743,6 +1825,7 @@ class TPUEngine:
                     self.pending[rid] = req
                 continue
             self._orphans.remove((rid, user, model, ts))
+            req.trace_event("admit")
             if self._place(req, user, model):
                 admitted += 1
         # Age out expiry tombstones nothing ever claimed (slow leak guard).
@@ -1786,6 +1869,7 @@ class TPUEngine:
                 # park it and retry for a grace period.
                 self._orphans.append((rid, user, model, time.monotonic()))
                 continue
+            req.trace_event("admit")
             if self._place(req, user, model):
                 admitted += 1
         return admitted
@@ -1833,6 +1917,7 @@ class TPUEngine:
             # Empty-model requests always pass the native gate, so a
             # requeue would spin; park on the least-loaded live replica.
             rt.force_submit(req)
+        req.trace_event("place", runtime=getattr(rt, "name", model))
         self.core.mark_started(user)
         return True
 
@@ -1847,6 +1932,7 @@ class TPUEngine:
                                                   kind=req.kind)
                 req.req_id = new_rid
                 self.pending[new_rid] = req
+            req.trace_event("requeue")
         except BlockedError:
             self.core.mark_dropped(user, started=False)
             req.finish(FinishReason.CANCELLED)
@@ -2085,6 +2171,11 @@ class TPUEngine:
         """Per-chip rows; the SPMD engine overrides to merge worker
         hosts' chips from the KV store."""
         return per_chip_stats()
+
+    def worker_metric_snapshots(self) -> List[dict]:
+        """Peer-host registry snapshots to merge into /metrics; the SPMD
+        engine overrides to read them off the KV store."""
+        return []
 
     def stats(self) -> dict:
         runtime_stats = [rt.stats() for rt in self.runtimes.values()]

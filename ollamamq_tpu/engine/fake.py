@@ -17,6 +17,7 @@ from ollamamq_tpu.config import EngineConfig, get_model_config
 from ollamamq_tpu.engine.engine import TPUEngine
 from ollamamq_tpu.engine.request import FinishReason, Request, StreamItem
 from ollamamq_tpu.engine.tokenizer import ByteTokenizer
+from ollamamq_tpu.telemetry import schema as tm
 
 
 class FakeRuntime:
@@ -40,6 +41,15 @@ class FakeRuntime:
         self.prefill_latency_ms = 0.0
         self.param_bytes = 0
         self.kv_bytes = 0
+        # Same metric surface as ModelRuntime, so the exposition (and the
+        # e2e telemetry tests) look identical under the fake engine.
+        self._tm_ttft = tm.TTFT_MS.labels(model=name)
+        self._tm_tpot = tm.TPOT_MS.labels(model=name)
+        self._tm_tokens = tm.TOKENS_GENERATED_TOTAL.labels(model=name)
+        self._tm_occupancy = tm.BATCH_OCCUPANCY.labels(model=name)
+        self._tm_mfu = tm.MFU.labels(model=name)
+        self._tm_occupancy.set(0.0)
+        self._tm_mfu.set(0.0)
 
     def has_capacity(self, kind=None) -> bool:
         return len(self.active) + len(self.pending_prefill) < self.ecfg.max_slots
@@ -71,14 +81,17 @@ class FakeRuntime:
                 req.finish(FinishReason.CANCELLED)
                 continue
             if self.is_encoder or req.kind == "embed":
+                req.trace_event("embed_batch", tokens=len(req.prompt_tokens))
                 req.embedding = self._fake_embedding(req)
                 req.stats.first_token_at = time.monotonic()
                 core.mark_done(req.user, tokens=len(req.prompt_tokens))
                 req.finish(FinishReason.STOP)
             else:
+                req.trace_event("prefill", tokens=len(req.prompt_tokens))
                 req._fake_remaining = min(req.sampling.max_tokens, 16)
                 req._fake_idx = 0
                 self.active.append(req)
+        self._tm_occupancy.set(len(self.active) / max(1, self.ecfg.max_slots))
         if self.token_latency_s:
             time.sleep(self.token_latency_s)
         for req in list(self.active):
@@ -92,8 +105,13 @@ class FakeRuntime:
             req._fake_remaining -= 1
             req.generated_ids.append(req._fake_idx)
             self.tokens_generated += 1
+            self._tm_tokens.inc()
             if not req.stats.first_token_at:
                 req.stats.first_token_at = time.monotonic()
+                self._tm_ttft.observe(req.stats.ttft_ms)
+                self._tm_tpot.observe(self.token_latency_s * 1e3)
+                req.trace_event("first_token",
+                                ttft_ms=round(req.stats.ttft_ms, 3))
             chunk = req.emit_text(word)
             if chunk is None:
                 self.active.remove(req)
@@ -132,6 +150,7 @@ class FakeRuntime:
             "step_latency_ms": round(self.token_latency_s * 1e3, 3),
             "prefill_latency_ms": 0.0,
             "tokens_generated": self.tokens_generated,
+            "mfu": 0.0,
             "param_bytes": self.param_bytes,
             "kv_bytes": self.kv_bytes,
         }

@@ -145,6 +145,10 @@ class Request:
         self.stream = TokenStream()
         self.stats = RequestStats(prompt_tokens=len(self.prompt_tokens))
         self.cancelled = threading.Event()
+        # Lifecycle trace (telemetry.tracing.Trace), attached by the
+        # engine's enqueue path; None for directly-constructed Requests
+        # (bench, unit tests) — every trace hook below no-ops then.
+        self.trace = None
         # Generation state (engine-owned):
         self.generated_ids: List[int] = []
         self.emitted_len = 0  # chars of detok text already pushed
@@ -189,7 +193,16 @@ class Request:
     def full_text(self) -> str:
         return self._detok_text[: self.emitted_len]
 
+    def trace_event(self, name: str, **args) -> None:
+        """Record a lifecycle span event; no-op for untraced requests."""
+        tr = self.trace
+        if tr is not None:
+            tr.event(name, **args)
+
     def finish(self, reason: FinishReason, error: str = "") -> None:
         self.stats.finished_at = time.monotonic()
         kind = "error" if reason == FinishReason.ERROR else "done"
         self.stream.push(StreamItem(kind, finish_reason=reason, error=error))
+        tr = self.trace
+        if tr is not None:
+            tr.finish(reason.value)

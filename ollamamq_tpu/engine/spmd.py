@@ -191,6 +191,7 @@ def start_heartbeat() -> None:
         import json as _json
 
         from ollamamq_tpu.engine.engine import per_chip_stats
+        from ollamamq_tpu.telemetry.metrics import REGISTRY
 
         n = 0
         while True:
@@ -202,6 +203,12 @@ def start_heartbeat() -> None:
                 # the whole pod, not device 0 of host 0).
                 client.key_value_set(f"ollamamq/chips/{pid}",
                                      _json.dumps(per_chip_stats()),
+                                     allow_overwrite=True)
+                # ... and this host's full metrics snapshot: the primary's
+                # /metrics merges peer counters/histograms so the pod
+                # reads as ONE exposition (primary skips its own key).
+                client.key_value_set(f"ollamamq/metrics/{pid}",
+                                     REGISTRY.snapshot_json(),
                                      allow_overwrite=True)
             except Exception:
                 pass  # coordinator gone: process is exiting anyway
@@ -795,6 +802,25 @@ class SPMDEngine:
                     chips.sort(key=lambda c: (c.get("process", 0),
                                               c.get("id", 0)))
                 return chips
+
+            def worker_metric_snapshots(self):
+                if jax.process_count() <= 1:
+                    return []
+                import json as _json
+
+                client = _kv_client()
+                me = jax.process_index()
+                out = []
+                for p in range(jax.process_count()):
+                    if p == me:
+                        continue
+                    try:
+                        v = client.key_value_try_get(f"ollamamq/metrics/{p}")
+                        if v:
+                            out.append(_json.loads(v))
+                    except Exception:
+                        pass  # host not publishing yet (or dead)
+                return out
 
             def stop(self):
                 super().stop()

@@ -97,7 +97,11 @@ class Server:
         r.add_route("*", "/v1/embeddings", self.v1_embeddings)
         r.add_route("*", "/v1/models", self.v1_models)
         r.add_route("*", "/v1/models/{model}", self.v1_model)
-        r.add_route("GET", "/metrics", self.metrics)  # TPU-era observability
+        # TPU-era observability: Prometheus exposition, the legacy JSON
+        # payload (TUI / scripts), and Chrome trace-event request traces.
+        r.add_route("GET", "/metrics", self.metrics)
+        r.add_route("GET", "/metrics.json", self.metrics_json)
+        r.add_route("GET", "/debug/trace", self.debug_trace)
         r.add_route("POST", "/debug/profile", self.debug_profile)
         if self.allow_all_routes:
             r.add_route("*", "/{tail:.*}", self.fallback)
@@ -231,8 +235,70 @@ class Server:
         return web.Response(text="Ollama is running")
 
     async def metrics(self, request: web.Request) -> web.Response:
+        """Prometheus text exposition (format 0.0.4). Scrape-time-derived
+        gauges (queue depth per user, per-chip HBM, uptime) refresh here;
+        hot-path metrics are already up to date in the registry. The
+        snapshot runs off the event loop — core.snapshot and chip_stats
+        can block on FFI / device round-trips."""
+        self._ident(request)
+        text = await asyncio.get_running_loop().run_in_executor(
+            None, self._render_prometheus)
+        return web.Response(
+            body=text.encode(),
+            headers={"Content-Type":
+                     "text/plain; version=0.0.4; charset=utf-8"})
+
+    def _render_prometheus(self) -> str:
+        from ollamamq_tpu.telemetry import REGISTRY
+        from ollamamq_tpu.telemetry import schema as tm
+
+        eng = self.engine
+        tm.UPTIME_SECONDS.set(time.time() - eng.started_at)
+        # Queue depth per user: rebuilt each scrape so departed users'
+        # series don't linger.
+        try:
+            users = eng.core.snapshot().get("users", {})
+            tm.QUEUE_DEPTH.clear()
+            for user, row in users.items():
+                tm.QUEUE_DEPTH.labels(user=user).set(row.get("queued", 0))
+        except Exception:
+            log.exception("queue-depth scrape failed")
+        # Per-chip HBM: chips whose backend has no memory_stats are
+        # OMITTED (n/a), never exported as a fake 0-byte reading.
+        try:
+            tm.HBM_USED_BYTES.clear()
+            tm.HBM_TOTAL_BYTES.clear()
+            for c in eng.chip_stats():
+                if not c.get("memory_stats"):
+                    continue
+                lab = {"chip": str(c.get("id", 0)),
+                       "host": str(c.get("process", 0))}
+                tm.HBM_USED_BYTES.labels(**lab).set(c.get("hbm_used", 0))
+                tm.HBM_TOTAL_BYTES.labels(**lab).set(c.get("hbm_total", 0))
+        except Exception:
+            log.exception("chip-stats scrape failed")
+        extra = []
+        try:
+            extra = eng.worker_metric_snapshots()
+        except Exception:
+            log.exception("worker metric snapshot fetch failed")
+        return REGISTRY.render(extra_snapshots=extra)
+
+    async def metrics_json(self, request: web.Request) -> web.Response:
+        """The pre-Prometheus ad-hoc JSON payload (runtimes/chips/queue);
+        the TUI and ops scripts read this shape."""
         self._ident(request)
         return web.json_response(self.engine.stats())
+
+    async def debug_trace(self, request: web.Request) -> web.Response:
+        """Request-lifecycle traces as Chrome trace-event JSON: load in
+        chrome://tracing or Perfetto to read a wedged/slow request off
+        its span timeline."""
+        self._ident(request)
+        tracer = getattr(self.engine, "tracer", None)
+        if tracer is None:
+            raise ApiError(501, "this engine does not trace requests")
+        return web.json_response(tracer.export_chrome())
 
     async def debug_profile(self, request: web.Request) -> web.Response:
         """Capture a jax.profiler trace of the live engine for N seconds
@@ -260,11 +326,21 @@ class Server:
             import jax
 
             jax.profiler.start_trace(out_dir)
-            time.sleep(seconds)
-            jax.profiler.stop_trace()
+            try:
+                time.sleep(seconds)
+            finally:
+                # stop_trace must run even if the sleep is interrupted:
+                # a started-but-never-stopped jax profiler refuses every
+                # later start_trace, wedging the endpoint permanently.
+                jax.profiler.stop_trace()
 
         try:
             await asyncio.get_running_loop().run_in_executor(None, run_trace)
+        except Exception as e:
+            # A failed capture answers 500 and — via the finally below —
+            # clears the capture-running flag, so the NEXT capture gets a
+            # fresh try instead of 409 forever.
+            raise ApiError(500, f"profile capture failed: {e}")
         finally:
             self._profiling = False
         return web.json_response({"status": "success", "trace_dir": out_dir,

@@ -1,0 +1,98 @@
+"""THE declaration site for every ollamamq_* metric.
+
+Everything the process exports lives here, so (a) the engine/server grab
+handles instead of re-declaring names inline, and (b)
+scripts/check_metrics_docs.py can enumerate the full metric surface by
+importing this one module — no engine, no jax — and diff it against the
+README's Observability table.
+
+Naming: `ollamamq_` prefix; latencies in milliseconds carry an `_ms`
+suffix; counters carry `_total`. Per-model series are labeled
+{model=...}; per-user queue depth {user=...}; per-chip HBM {chip=,host=}.
+"""
+
+from __future__ import annotations
+
+from ollamamq_tpu.telemetry.metrics import (DEFAULT_LATENCY_BUCKETS_MS,
+                                            REGISTRY)
+
+# -- request latency histograms (re-bucketable via --metrics-buckets) ------
+TTFT_MS = REGISTRY.histogram(
+    "ollamamq_ttft_ms",
+    "Time to first token per request, enqueue to first sampled token (ms)",
+    buckets=DEFAULT_LATENCY_BUCKETS_MS, labels=("model",))
+TPOT_MS = REGISTRY.histogram(
+    "ollamamq_tpot_ms",
+    "Time per output token: decode step latency per emitted token (ms)",
+    buckets=DEFAULT_LATENCY_BUCKETS_MS, labels=("model",))
+STEP_LATENCY_MS = REGISTRY.histogram(
+    "ollamamq_step_latency_ms",
+    "Decode step device latency, blocked-collect time per fused step (ms)",
+    buckets=DEFAULT_LATENCY_BUCKETS_MS, labels=("model",))
+PREFILL_LATENCY_MS = REGISTRY.histogram(
+    "ollamamq_prefill_latency_ms",
+    "Prefill forward latency per dispatched batch or chunk (ms)",
+    buckets=DEFAULT_LATENCY_BUCKETS_MS, labels=("model",))
+
+# -- engine occupancy / utilization gauges ---------------------------------
+BATCH_OCCUPANCY = REGISTRY.gauge(
+    "ollamamq_batch_occupancy",
+    "Active decode slots / max_slots (0..1), sampled per decode step",
+    labels=("model",))
+KV_PAGES_USED = REGISTRY.gauge(
+    "ollamamq_kv_pages_used",
+    "KV cache pages currently allocated", labels=("model",))
+KV_PAGE_UTILIZATION = REGISTRY.gauge(
+    "ollamamq_kv_page_utilization",
+    "KV cache pages allocated / pool size (0..1)", labels=("model",))
+MFU = REGISTRY.gauge(
+    "ollamamq_mfu",
+    "Model FLOPs utilization (0..1): analytic FLOPs/token x tokens per "
+    "decode step over per-chip peak FLOPs x chips (0 when the peak for "
+    "this accelerator is unknown; override with OLLAMAMQ_PEAK_FLOPS)",
+    labels=("model",))
+FLOPS_PER_TOKEN = REGISTRY.gauge(
+    "ollamamq_model_flops_per_token",
+    "Analytic forward FLOPs per generated token at zero context "
+    "(2 x active params; attention adds ~4 x layers x ctx x q_dim)",
+    labels=("model",))
+
+# -- queue / request flow --------------------------------------------------
+QUEUE_DEPTH = REGISTRY.gauge(
+    "ollamamq_queue_depth",
+    "Requests waiting in the fair-share queue, per user",
+    labels=("user",))
+REQUESTS_INFLIGHT = REGISTRY.gauge(
+    "ollamamq_requests_inflight",
+    "Requests accepted and not yet finished (any kind)")
+REQUESTS_TOTAL = REGISTRY.counter(
+    "ollamamq_requests_total",
+    "Finished requests by outcome (stop/length/cancelled/error)",
+    labels=("model", "outcome"))
+TOKENS_GENERATED_TOTAL = REGISTRY.counter(
+    "ollamamq_tokens_generated_total",
+    "Tokens sampled across all requests", labels=("model",))
+PROMPT_TOKENS_TOTAL = REGISTRY.counter(
+    "ollamamq_prompt_tokens_total",
+    "Prompt tokens prefilled across all requests", labels=("model",))
+
+# -- host / device ---------------------------------------------------------
+HBM_USED_BYTES = REGISTRY.gauge(
+    "ollamamq_hbm_used_bytes",
+    "Per-chip HBM in use (chips without memory_stats are omitted, "
+    "never reported as 0)", labels=("chip", "host"))
+HBM_TOTAL_BYTES = REGISTRY.gauge(
+    "ollamamq_hbm_total_bytes",
+    "Per-chip HBM capacity", labels=("chip", "host"))
+UPTIME_SECONDS = REGISTRY.gauge(
+    "ollamamq_uptime_seconds", "Engine uptime")
+
+_LATENCY_HISTOGRAMS = (TTFT_MS, TPOT_MS, STEP_LATENCY_MS, PREFILL_LATENCY_MS)
+
+
+def configure_latency_buckets(bounds) -> None:
+    """Apply the --metrics-buckets ladder to every latency histogram.
+    Resets prior observations (boundaries don't translate); call at
+    startup, before serving."""
+    for h in _LATENCY_HISTOGRAMS:
+        h.set_buckets(bounds)

@@ -1,0 +1,127 @@
+"""Request-lifecycle tracing: span events per request, bounded ring.
+
+Every request the engine accepts carries a Trace; the engine drops span
+events at each lifecycle boundary (enqueue -> admit -> place -> prefill
+[per chunk] -> first_token -> decode [sampled] -> stop/cancelled/error).
+Consecutive events define contiguous phase spans — gapless by
+construction — so a wedged or slow request reads straight off the
+timeline in chrome://tracing / Perfetto via GET /debug/trace.
+
+Finished traces live in a bounded ring (oldest evicted); in-flight
+traces are exported too — those are exactly the ones an operator
+debugging a wedge needs to see.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ollamamq_tpu.telemetry import schema as tm
+
+# Per-trace event cap: a 100k-token generation must not grow its trace
+# unboundedly. Terminal events always land (the chain must end).
+MAX_EVENTS = 256
+# Sample cadence for decode-progress events after the first token.
+DECODE_EVENT_EVERY = 16
+
+
+class Trace:
+    __slots__ = ("req_id", "user", "model", "kind", "events", "dropped",
+                 "finished", "_tracer")
+
+    def __init__(self, tracer: "Tracer", req_id: int, user: str, model: str,
+                 kind: str):
+        self._tracer = tracer
+        self.req_id = req_id
+        self.user = user
+        self.model = model
+        self.kind = kind
+        self.events: List[tuple] = []  # (name, t_monotonic, args|None)
+        self.dropped = 0
+        self.finished = False
+
+    def event(self, name: str, _force: bool = False, **args) -> None:
+        if self.finished:
+            return
+        if len(self.events) >= MAX_EVENTS and not _force:
+            self.dropped += 1
+            return
+        self.events.append((name, time.monotonic(), args or None))
+
+    def finish(self, outcome: str) -> None:
+        """Terminal event + hand the trace to the ring. Idempotent — the
+        cancel and finish paths can race to it."""
+        if self.finished:
+            return
+        self.event(outcome, _force=True)
+        self.finished = True
+        self._tracer._finished(self, outcome)
+
+
+class Tracer:
+    """Owner of the live-trace table and the finished-trace ring."""
+
+    def __init__(self, capacity: int = 512):
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=max(1, capacity))
+        self._live: Dict[int, Trace] = {}
+        self.epoch = time.monotonic()
+
+    def begin(self, req_id: int, user: str, model: str,
+              kind: str = "generate") -> Trace:
+        tr = Trace(self, req_id, user, model, kind)
+        tr.event("enqueue")
+        with self._lock:
+            self._live[id(tr)] = tr
+        tm.REQUESTS_INFLIGHT.inc()
+        return tr
+
+    def _finished(self, tr: Trace, outcome: str) -> None:
+        with self._lock:
+            self._live.pop(id(tr), None)
+            self._ring.append(tr)
+        tm.REQUESTS_INFLIGHT.dec()
+        tm.REQUESTS_TOTAL.labels(model=tr.model or "?", outcome=outcome).inc()
+
+    def traces(self) -> List[Trace]:
+        with self._lock:
+            return list(self._ring) + list(self._live.values())
+
+    def export_chrome(self) -> dict:
+        """Chrome trace-event JSON (the chrome://tracing 'JSON Array
+        Format' wrapped in an object): consecutive events of a request
+        become complete ("X") spans named after the phase they open; the
+        terminal event is an instant ("i") mark. tid = req_id, so each
+        request renders as its own row."""
+        events: List[dict] = []
+        for tr in self.traces():
+            evs = list(tr.events)  # engine thread may still append; copy
+            tid = tr.req_id
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+                "args": {"name": f"req {tr.req_id} {tr.user} "
+                                 f"{tr.model or '?'} [{tr.kind}]"},
+            })
+            for i, (name, t, args) in enumerate(evs):
+                ts = (t - self.epoch) * 1e6  # Chrome wants microseconds
+                ev = {"name": name, "pid": 1, "tid": tid, "ts": ts,
+                      "cat": tr.kind}
+                if args:
+                    ev["args"] = args
+                if i + 1 < len(evs):
+                    ev["ph"] = "X"
+                    ev["dur"] = (evs[i + 1][1] - t) * 1e6
+                else:
+                    ev["ph"] = "i"
+                    ev["s"] = "t"
+                events.append(ev)
+            if tr.dropped:
+                events.append({
+                    "name": f"{tr.dropped} events dropped", "ph": "i",
+                    "s": "t", "pid": 1, "tid": tid,
+                    "ts": (evs[-1][1] - self.epoch) * 1e6 if evs else 0,
+                })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}

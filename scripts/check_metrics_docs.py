@@ -1,0 +1,69 @@
+#!/usr/bin/env python3
+"""Doc/metric consistency gate: every metric the registry exports must be
+documented in README.md's Observability table, and every documented
+ollamamq_* name must still exist in the registry (no ghost docs).
+
+Imports ONLY ollamamq_tpu.telemetry.schema — the single declaration site
+for the metric surface — so the check runs without jax, a device, or an
+engine. Wired into tier-1 via tests/test_metrics_docs.py.
+
+Usage: python scripts/check_metrics_docs.py [README.md]
+Exit 0 = consistent; 1 = drift (names printed); 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def documented_metric_names(readme_text: str) -> set:
+    """ollamamq_* names that appear in backticks anywhere in the README
+    (the Observability table is the intended home; being generous about
+    WHERE keeps the check about coverage, not markdown layout)."""
+    return set(re.findall(r"`(ollamamq_[a-z0-9_]+)`", readme_text))
+
+
+def registered_metric_names() -> set:
+    sys.path.insert(0, _REPO)
+    from ollamamq_tpu.telemetry import schema  # noqa: F401  (declares all)
+    from ollamamq_tpu.telemetry.metrics import REGISTRY
+
+    return set(REGISTRY.names())
+
+
+def main(argv) -> int:
+    readme = argv[1] if len(argv) > 1 else os.path.join(_REPO, "README.md")
+    try:
+        with open(readme, encoding="utf-8") as f:
+            text = f.read()
+    except OSError as e:
+        print(f"cannot read {readme}: {e}", file=sys.stderr)
+        return 2
+    documented = documented_metric_names(text)
+    registered = registered_metric_names()
+    missing = sorted(registered - documented)
+    ghosts = sorted(documented - registered)
+    rc = 0
+    if missing:
+        rc = 1
+        print(f"{readme}: {len(missing)} registered metric(s) missing from "
+              "the README metric table:", file=sys.stderr)
+        for name in missing:
+            print(f"  - {name}", file=sys.stderr)
+    if ghosts:
+        rc = 1
+        print(f"{readme}: {len(ghosts)} documented metric(s) no longer "
+              "registered:", file=sys.stderr)
+        for name in ghosts:
+            print(f"  - {name}", file=sys.stderr)
+    if rc == 0:
+        print(f"ok: {len(registered)} metrics, all documented")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -370,7 +370,7 @@ async def test_user_id_header_default_anonymous(client):
         "model": "test-tiny", "prompt": "x", "stream": False,
         "options": {"num_predict": 1},
     })
-    r = await client.get("/metrics")
+    r = await client.get("/metrics.json")
     stats = await r.json()
     assert "anonymous" in stats["queue"]["users"]
 
@@ -381,7 +381,7 @@ async def test_user_id_header_tracked(client):
         "model": "test-tiny", "prompt": "x", "stream": False,
         "options": {"num_predict": 1},
     }, headers={"X-User-ID": "alice"})
-    r = await client.get("/metrics")
+    r = await client.get("/metrics.json")
     stats = await r.json()
     assert stats["queue"]["users"]["alice"]["processed"] == 1
 
@@ -392,7 +392,8 @@ async def test_blocked_user_403_on_all_proxied_routes(client):
     check (every proxy_handler route 403s); only /health is exempt."""
     client.engine.core.block_user("banned")
     hdr = {"X-User-ID": "banned"}
-    for path in ("/", "/api/version", "/api/tags", "/v1/models", "/metrics"):
+    for path in ("/", "/api/version", "/api/tags", "/v1/models", "/metrics",
+                 "/metrics.json", "/debug/trace"):
         r = await client.get(path, headers=hdr)
         assert r.status == 403, path
     r = await client.get("/health", headers=hdr)

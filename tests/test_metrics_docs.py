@@ -1,0 +1,36 @@
+"""CI wiring for scripts/check_metrics_docs.py: the registry's metric
+surface and README.md's Observability table must not drift. Runs in
+tier-1 (non-slow, no jax/engine needed by the script)."""
+
+import importlib.util
+import os
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SCRIPT = os.path.join(_REPO, "scripts", "check_metrics_docs.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("check_metrics_docs",
+                                                  _SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_readme_documents_every_registered_metric():
+    mod = _load()
+    assert mod.main(["check_metrics_docs.py"]) == 0
+
+
+def test_checker_catches_missing_and_ghost_names(tmp_path):
+    mod = _load()
+    # Missing: a README without any metric names.
+    bare = tmp_path / "README_bare.md"
+    bare.write_text("# no metrics documented here\n")
+    assert mod.main(["check_metrics_docs.py", str(bare)]) == 1
+    # Ghost: documents a metric the registry never registered.
+    with open(os.path.join(_REPO, "README.md"), encoding="utf-8") as f:
+        full = f.read()
+    ghost = tmp_path / "README_ghost.md"
+    ghost.write_text(full + "\n| `ollamamq_definitely_not_real` | gauge |\n")
+    assert mod.main(["check_metrics_docs.py", str(ghost)]) == 1
