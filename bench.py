@@ -45,6 +45,8 @@ def _fallback_argv(model: str) -> list:
             "--model", model, "--slots", "4", "--prompt-len", "32",
             "--steps", "16", "--warmup-steps", "4", "--chunk", "4",
             "--ttft-samples", "2", "--sweep-chunks", "",
+            "--shared-prefix", "2", "--shared-prefix-len", "64",
+            "--shared-prefix-tail", "16",
             "--init-timeout", "300"]
 
 
@@ -102,6 +104,15 @@ def main() -> int:
     p.add_argument("--embed-model", default="",
                    help="if set, also measure embedding batch throughput "
                         "on this encoder model (BASELINE config 3)")
+    p.add_argument("--shared-prefix", type=int, default=4,
+                   help="users in the shared_prefix scenario (N requests "
+                        "behind one common system prompt, TTFT measured "
+                        "with the prefix cache off vs on); 0 disables")
+    p.add_argument("--shared-prefix-len", type=int, default=512,
+                   help="common system-prompt length in tokens (should be "
+                        "a multiple of --page-size)")
+    p.add_argument("--shared-prefix-tail", type=int, default=32,
+                   help="per-user unique prompt tail in tokens")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU platform (smoke-testing the harness)")
     p.add_argument("--init-timeout", type=float, default=300.0,
@@ -479,6 +490,21 @@ def main() -> int:
         "mfu": round(tm.MFU.labels(model=args.model).value, 4),
     }
 
+    # Shared-prefix scenario: N users behind one common system prompt,
+    # TTFT measured with the prefix cache OFF then ON against the same
+    # runtime (the cache is attached between legs). Reports the hit
+    # ratio and the TTFT delta the radix-tree KV reuse buys.
+    shared_prefix = None
+    if args.shared_prefix > 0:
+        try:
+            shared_prefix = _shared_prefix_scenario(rt, core, args, rng, touch)
+        except Exception as e:  # never discard the decode numbers
+            shared_prefix = {"error": f"{type(e).__name__}: {e}"}
+            print(f"# shared_prefix scenario failed: {shared_prefix['error']}",
+                  file=sys.stderr)
+        finally:
+            rt.prefix_cache = None  # detach: rt state stays cache-free
+
     result = {
         "metric": "decode_tok_per_s_per_chip",
         "value": round(tok_per_s, 1),
@@ -514,9 +540,79 @@ def main() -> int:
             result["embed_tok_per_s"] = round(embed_tok_per_s, 1)
         if embed_error is not None:
             result["embed_error"] = embed_error
+    if shared_prefix is not None:
+        result["shared_prefix"] = shared_prefix
     run_done.set()
     print(json.dumps(result), flush=True)
     return 0
+
+
+def _shared_prefix_scenario(rt, core, args, rng, touch):
+    """TTFT for N same-prefix users, cache off vs on, on a drained
+    runtime. One warmup (compile) request per leg is excluded from the
+    means; the on-leg warmup also seeds the tree, so every timed on-leg
+    request is a hit."""
+    import statistics
+    import time
+
+    import numpy as np
+
+    from ollamamq_tpu.engine.prefix_cache import PrefixCache
+    from ollamamq_tpu.engine.request import FinishReason, Request
+    from ollamamq_tpu.ops.sampling import SamplingParams
+
+    ps = rt.ecfg.page_size
+    prefix_len = max(ps, (args.shared_prefix_len // ps) * ps)
+    tail_len = max(1, args.shared_prefix_tail)
+    n = prefix_len + tail_len
+    if rt.alloc.pages_needed(n + 1) > rt.ecfg.max_pages_per_seq:
+        return {"skipped": f"prompt of {n} tokens exceeds the page budget "
+                           f"({rt.ecfg.max_pages_per_seq} pages/seq)"}
+    hi = min(rt.cfg.vocab_size, 30000)
+    prefix = rng.integers(3, hi, size=prefix_len).tolist()
+
+    def drain():
+        for s, r in enumerate(rt.slot_req):
+            if r is not None:
+                rt._finish_slot(s, FinishReason.CANCELLED, core)
+
+    def run_one(i):
+        prompt = prefix + rng.integers(3, hi, size=tail_len).tolist()
+        req = Request(20000 + i, f"spuser{i}", rt.name, prompt,
+                      SamplingParams(max_tokens=10**9))
+        req._inc_decode = rt.tokenizer.make_incremental_decoder()
+        rt.pending_prefill.append(req)
+        t0 = time.monotonic()
+        while not req.stats.first_token_at:
+            progressed = rt.step_prefill(core)
+            progressed = rt.step_chunk(core) or progressed
+            touch("shared_prefix")
+            if not progressed and not rt.chunking:
+                raise RuntimeError("shared_prefix request never admitted "
+                                   "(page budget?)")
+        ms = (time.monotonic() - t0) * 1e3
+        drain()  # finish-on-install: the on-leg insert populates the tree
+        return ms
+
+    drain()
+    legs = {}
+    for leg, idx0 in (("off", 0), ("on", 1000)):
+        if leg == "on":
+            rt.prefix_cache = PrefixCache(ps, rt.alloc, model=rt.name)
+        run_one(idx0)  # warmup: compiles (off) / seeds the tree (on)
+        legs[leg] = statistics.mean(
+            run_one(idx0 + 1 + i) for i in range(args.shared_prefix))
+    stats = rt.prefix_cache.stats()
+    return {
+        "users": args.shared_prefix,
+        "prefix_tokens": prefix_len,
+        "tail_tokens": tail_len,
+        "hit_ratio": stats["hit_ratio"],
+        "tokens_saved": stats["tokens_saved"],
+        "ttft_cache_off_ms": round(legs["off"], 1),
+        "ttft_cache_on_ms": round(legs["on"], 1),
+        "ttft_delta_ms": round(legs["off"] - legs["on"], 1),
+    }
 
 
 if __name__ == "__main__":

@@ -317,18 +317,37 @@ struct Tui {
      * FLOPs model over chip peak); 0 renders as "--" (unknown peak, e.g.
      * CPU meshes, or no decode step yet). */
     double mfu = 0;
+    /* Prefix-cache hit ratio: summed hits/misses over runtimes that
+     * cache ("prefix_cache" non-null). No caching runtime => "n/a". */
+    bool cache_on = false;
+    double cache_hits = 0, cache_lookups = 0;
     auto models_mfu = stats->get("models");
     if (models_mfu)
       for (auto &m : models_mfu->arr) {
         double v = m->get("mfu") ? m->get("mfu")->as_num() : 0;
         if (v > mfu) mfu = v;
+        auto pc = m->get("prefix_cache");
+        if (pc && !pc->is_null()) {
+          cache_on = true;
+          double h = pc->get("hits") ? pc->get("hits")->as_num() : 0;
+          double mi = pc->get("misses") ? pc->get("misses")->as_num() : 0;
+          cache_hits += h;
+          cache_lookups += h + mi;
+        }
       }
-    if (mfu > 0)
-      std::snprintf(l, sizeof l, " throughput %.0f tok/s   MFU %.2f%%",
-                    tok_rate > 0 ? tok_rate : 0.0, mfu * 100.0);
+    char cache[32];
+    if (!cache_on)
+      std::snprintf(cache, sizeof cache, "cache n/a");
     else
-      std::snprintf(l, sizeof l, " throughput %.0f tok/s   MFU --",
-                    tok_rate > 0 ? tok_rate : 0.0);
+      std::snprintf(cache, sizeof cache, "cache %.0f%%",
+                    cache_lookups > 0 ? 100.0 * cache_hits / cache_lookups
+                                      : 0.0);
+    if (mfu > 0)
+      std::snprintf(l, sizeof l, " throughput %.0f tok/s   MFU %.2f%%   %s",
+                    tok_rate > 0 ? tok_rate : 0.0, mfu * 100.0, cache);
+    else
+      std::snprintf(l, sizeof l, " throughput %.0f tok/s   MFU --   %s",
+                    tok_rate > 0 ? tok_rate : 0.0, cache);
     out.push_back(std::string(CYAN) + l + RST);
     /* One row PER chip (pod-wide under SPMD): the north star's "per-chip
      * HBM occupancy" — a v5e-16 must not show chip 0 for the pod. */

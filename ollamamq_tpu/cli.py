@@ -52,6 +52,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-new-tokens", type=int, default=256)
     p.add_argument("--decode-steps", type=int, default=8,
                    help="decode steps fused per dispatch when idle")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="automatic prefix caching: share finished prompts' "
+                        "KV pages (page-granular radix tree) across "
+                        "requests; prefills only the uncached tail")
+    p.add_argument("--prefix-cache-min-pages", type=int, default=1,
+                   help="minimum matched full pages before a cached "
+                        "prefix is reused (smaller hits prefill normally)")
     # Mesh.
     p.add_argument("--dp", type=int, default=1, help="data-parallel axis size")
     p.add_argument("--sp", type=int, default=1, help="sequence-parallel axis size")
@@ -158,6 +165,8 @@ def main(argv=None) -> int:
         max_pages_per_seq=args.max_pages_per_seq,
         max_new_tokens=args.max_new_tokens,
         decode_steps_per_iter=args.decode_steps,
+        prefix_cache=args.prefix_cache,
+        prefix_cache_min_pages=args.prefix_cache_min_pages,
         dp=args.dp,
         sp=args.sp,
         tp=args.tp,

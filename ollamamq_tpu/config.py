@@ -222,6 +222,13 @@ class EngineConfig:
     # Repeat-penalty window: how many recent context tokens are penalized
     # (llama.cpp repeat_last_n; engine-wide static).
     repeat_last_n: int = 64
+    # Automatic prefix caching: finished prompts' full KV pages merge into
+    # a per-model radix tree (engine/prefix_cache.py); admissions sharing
+    # a prefix pin those pages and prefill only the uncached tail.
+    prefix_cache: bool = False
+    # Minimum matched FULL pages before the cached-tail path is taken —
+    # tiny hits aren't worth routing through the chunked prefill.
+    prefix_cache_min_pages: int = 1
     # Mesh axis sizes; tp=-1 means "all remaining devices". The engine
     # builds its (data, pipe, seq, expert, tensor) mesh from these unless
     # an explicit mesh object is passed to TPUEngine.

@@ -286,6 +286,17 @@ class ModelRuntime:
         self.alloc = kvc.PageAllocator(
             engine_cfg.num_pages, engine_cfg.page_size, engine_cfg.max_pages_per_seq
         )
+        # Automatic prefix caching: host-side radix tree of finished
+        # prompts' full KV pages (engine/prefix_cache.py). Under SPMD only
+        # the primary's admission path ever walks it — the page tables it
+        # produces already broadcast on the op wire.
+        self.prefix_cache = None
+        if engine_cfg.prefix_cache:
+            from ollamamq_tpu.engine.prefix_cache import PrefixCache
+
+            self.prefix_cache = PrefixCache(
+                engine_cfg.page_size, self.alloc, model=name,
+                min_pages=engine_cfg.prefix_cache_min_pages)
 
         S, MP = engine_cfg.max_slots, engine_cfg.max_pages_per_seq
         # Slots mid-chunked-prefill: reserved (not schedulable) but not yet
@@ -293,6 +304,9 @@ class ModelRuntime:
         self.reserved_slots: set = set()
         self.slot_req: List[Optional[Request]] = [None] * S
         self.slot_pages: List[List[int]] = [[] for _ in range(S)]
+        # Pinned prefix-cache nodes per slot (always a PREFIX of
+        # slot_pages: shared tree pages first, private pages after).
+        self.slot_pins: List[list] = [[] for _ in range(S)]
         self.page_table = np.full((S, MP), kvc.TRASH_PAGE, np.int32)
         self.seq_lens = np.zeros((S,), np.int32)
         self.last_tokens = np.zeros((S,), np.int32)
@@ -414,10 +428,14 @@ class ModelRuntime:
         embed_ok = len(self.pending_embed) < 4 * self.ecfg.max_slots
         if kind == "embed":
             return embed_ok
+        evictable = (self.prefix_cache.evictable_pages
+                     if self.prefix_cache is not None else 0)
         gen_ok = (
             len(self.pending_prefill) < 2 * self.ecfg.max_slots
             and self.free_slots() > 0
-            and self.alloc.free_pages >= 2
+            # Unreferenced cached pages count as capacity: allocator
+            # exhaustion under a full cache evicts, never rejects.
+            and self.alloc.free_pages + evictable >= 2
         )
         return gen_ok if kind == "generate" else (gen_ok or embed_ok)
 
@@ -467,13 +485,15 @@ class ModelRuntime:
                   jnp.asarray(freq), jnp.asarray(seeds), key)
 
     def _dispatch_chunk(self, chunk, tokens, start, cl, slot_id, is_final,
-                        pt_row, temp, tk, tp, pen, pres, freq, seeds, key):
+                        is_first, seed_row, pt_row, temp, tk, tp, pen, pres,
+                        freq, seeds, key):
         fn = self._get_chunk_jit(
             chunk, sampling_flags(temp, tk, tp, pen, pres, freq)
         )
         return fn(self.params, jnp.asarray(tokens), jnp.asarray(start),
                   jnp.asarray(cl), self.kc, self.vc, self.recent,
                   jnp.asarray(slot_id), jnp.asarray(is_final),
+                  jnp.asarray(is_first), jnp.asarray(seed_row),
                   jnp.asarray(pt_row), jnp.asarray(temp), jnp.asarray(tk),
                   jnp.asarray(tp), jnp.asarray(pen), jnp.asarray(pres),
                   jnp.asarray(freq), jnp.asarray(seeds), key)
@@ -561,7 +581,8 @@ class ModelRuntime:
             n_micro = self.ecfg.pp_microbatches
 
             def fn(params, tokens, start, chunk_lens, kc, vc, recent, slot_id,
-                   is_final, pt, temp, tk, tp, pen, pres, freq, seeds, key):
+                   is_final, is_first, seed_row, pt, temp, tk, tp, pen, pres,
+                   freq, seeds, key):
                 if pp > 1:
                     logits, kc, vc = pipeline.pp_forward_prefill_chunk(
                         params, cfg, tokens, start, chunk_lens, kc, vc, pt,
@@ -574,7 +595,12 @@ class ModelRuntime:
                 C = tokens.shape[1]
                 W = recent.shape[1]
                 row = recent[slot_id[0]]  # [W]
-                row = jnp.where(start[0] == 0, jnp.full_like(row, -1), row)
+                # First chunk of a request: the penalty ring starts from
+                # seed_row — all -1 for a fresh prompt, the cached
+                # prefix's last W tokens on a prefix-cache hit (start > 0
+                # then, so this can't key off start == 0). Travels on the
+                # SPMD wire like every other input, so hosts stay in step.
+                row = jnp.where(is_first[0] > 0, seed_row[0], row)
                 # Slide the window: prev ++ this chunk's valid tokens, then
                 # keep the last W (dynamic shift by chunk_len).
                 chunk_toks = jnp.where(
@@ -770,7 +796,9 @@ class ModelRuntime:
         req = self.slot_req[slot]
         if req is None:
             return
-        self._release_slot_pages(slot)
+        # Pass req: an installed slot's prompt KV is fully written, so
+        # its full prompt pages are insertable into the prefix cache.
+        self._release_slot_pages(slot, req)
         self.seq_lens[slot] = 0
         self.temp[slot] = 0.0
         self.top_k[slot] = 0
@@ -858,16 +886,58 @@ class ModelRuntime:
                     error=f"prompt length {n} exceeds maximum {max_prompt}",
                 )
                 continue
+            # Prefix-cache lookup: pin the longest cached full-page prefix
+            # and prefill only the uncached tail through the chunked path.
+            # SP runtimes keep their one-shot ring-attention forward for
+            # prompts beyond the largest bucket.
+            if (self.prefix_cache is not None
+                    and not (self._sp and n > largest)):
+                nodes, shared = self._match_prefix(req.prompt_tokens)
+                if nodes:
+                    if batch:
+                        break  # run the collected batch first
+                    slot = self._claim_slot(claimed)
+                    if slot is None:
+                        return False
+                    # Pin BEFORE the tail allocation: its eviction
+                    # backstop must never reclaim the very pages we
+                    # matched.
+                    self.prefix_cache.pin(nodes)
+                    tail = self._alloc_tail(len(shared), n + 1)
+                    if tail is None:
+                        self.prefix_cache.release(nodes)
+                        return False  # wait for frees
+                    self.pending_prefill.popleft()
+                    req.stats.prefill_started_at = time.monotonic()
+                    prefix_len = len(shared) * self.ecfg.page_size
+                    self.slot_pins[slot] = list(nodes)
+                    self.slot_pages[slot] = list(shared) + tail
+                    self.prefix_cache.note_hit(prefix_len)
+                    req.trace_event("prefix_hit", cached_tokens=prefix_len,
+                                    tokens=n)
+                    req._pt_row = kvc.make_page_table_row(
+                        self.slot_pages[slot], self.ecfg.max_pages_per_seq
+                    )[None, :]
+                    # The tail rides the chunked path starting at
+                    # prefix_len; decode writes start past the shared
+                    # pages, so they stay read-only (no copy-on-write).
+                    req._chunk_pos = prefix_len
+                    req._chunk_base = prefix_len
+                    req._prefill_slot = slot
+                    self.reserved_slots.add(slot)
+                    self.chunking.append(req)
+                    return True
             if n > largest:
                 if batch:
                     break  # run the collected batch first; chunk next tick
                 slot = self._claim_slot(claimed)
                 if slot is None:
                     return False
-                pages = self.alloc.alloc(n + 1)
+                pages = self._alloc_pages(n + 1)
                 if pages is None:
                     return False
                 self.pending_prefill.popleft()
+                self._pc_miss()
                 req.stats.prefill_started_at = time.monotonic()
                 self.slot_pages[slot] = pages
                 if self._sp:
@@ -900,10 +970,11 @@ class ModelRuntime:
             slot = self._claim_slot(claimed)
             if slot is None:
                 break
-            pages = self.alloc.alloc(n + 1)
+            pages = self._alloc_pages(n + 1)
             if pages is None:
                 break  # pool exhausted; run what we have, retry after frees
             self.pending_prefill.popleft()
+            self._pc_miss()
             req.stats.prefill_started_at = time.monotonic()
             self.slot_pages[slot] = pages
             self.page_table[slot, :] = kvc.make_page_table_row(
@@ -984,9 +1055,81 @@ class ModelRuntime:
                 return i
         return None
 
-    def _release_slot_pages(self, slot: int) -> None:
-        """Free a slot's KV pages and reset its page-table row."""
-        self.alloc.free(self.slot_pages[slot])
+    # -- prefix-cache seams ------------------------------------------------
+    def _match_prefix(self, tokens: List[int]):
+        """(nodes, pages) of the longest cached prefix, or ([], []) when
+        below the reuse threshold."""
+        nodes, pages = self.prefix_cache.match(tokens)
+        if len(nodes) < self.prefix_cache.min_pages:
+            return [], []
+        return nodes, pages
+
+    def _pc_miss(self) -> None:
+        if self.prefix_cache is not None:
+            self.prefix_cache.note_miss()
+
+    def _alloc_pages(self, num_tokens: int) -> Optional[List[int]]:
+        """alloc() with the prefix-cache eviction backstop: free-list
+        exhaustion reclaims unreferenced cached pages (LRU sweep) instead
+        of failing admission."""
+        pages = self.alloc.alloc(num_tokens)
+        if pages is None and self.prefix_cache is not None:
+            short = self.alloc.pages_needed(num_tokens) - self.alloc.free_pages
+            if short > 0 and self.prefix_cache.evict(short) > 0:
+                pages = self.alloc.alloc(num_tokens)
+        return pages
+
+    def _alloc_tail(self, held: int, num_tokens: int) -> Optional[List[int]]:
+        """Private tail pages for a cache-hit admission already holding
+        `held` shared pages; same eviction backstop as _alloc_pages."""
+        need = self.alloc.pages_needed(num_tokens) - held
+        pages = self.alloc.alloc_n(need, held=held)
+        if pages is None and self.prefix_cache is not None:
+            short = need - self.alloc.free_pages
+            if short > 0 and self.prefix_cache.evict(short) > 0:
+                pages = self.alloc.alloc_n(need, held=held)
+        return pages
+
+    def _extend_pages(self, pages: List[int], new_total_tokens: int) -> bool:
+        """Decode-time page growth with the eviction backstop."""
+        if self.alloc.extend(pages, new_total_tokens):
+            return True
+        if self.prefix_cache is None:
+            return False
+        need = self.alloc.pages_needed(new_total_tokens) - len(pages)
+        if need <= 0 or len(pages) + need > self.alloc.max_pages_per_seq:
+            return False  # per-seq cap: eviction can't help
+        if self.prefix_cache.evict(need - self.alloc.free_pages) > 0:
+            return self.alloc.extend(pages, new_total_tokens)
+        return False
+
+    def _release_slot_pages(self, slot: int,
+                            req: Optional[Request] = None) -> None:
+        """Free a slot's KV pages and reset its page-table row.
+
+        With the prefix cache on: always release the slot's pins; when
+        the finishing request is known (`req` passed — the slot was
+        installed, so the prompt's KV is fully written) its full prompt
+        pages MERGE into the tree instead of returning to the free list.
+        Callers without a req (mid-prefill cancel, runtime failure) free
+        every private page and only unpin."""
+        pages = self.slot_pages[slot]
+        pc = self.prefix_cache
+        if pc is None:
+            self.alloc.free(pages)
+        else:
+            pins = self.slot_pins[slot]
+            keep = len(pins)  # shared tree pages lead slot_pages
+            if req is not None and req.prompt_tokens:
+                full = min(len(req.prompt_tokens) // self.ecfg.page_size,
+                           len(pages))
+                if full > keep:
+                    pc.insert(req.prompt_tokens, pages[:full])
+                    keep = full
+            self.alloc.free(pages[keep:])
+            pc.release(pins)
+            self.slot_pages[slot] = []
+            self.slot_pins[slot] = []
         self.page_table[slot, :] = kvc.TRASH_PAGE
 
     def _install_slot(self, slot: int, req: Request, n: int, tok: int,
@@ -1029,17 +1172,31 @@ class ModelRuntime:
 
         s = req.sampling
         chunk_start = req._chunk_pos
+        base = getattr(req, "_chunk_base", 0)  # >0: cached-prefix tail
+        # Chunk size = smallest bucket covering the remainder (compiles
+        # once per bucket, like batched prefill): a short cache-hit tail
+        # must not pay a largest-bucket forward.
         piece = req.prompt_tokens[chunk_start:chunk_start + largest]
         cl = len(piece)
-        tokens = np.zeros((1, largest), np.int32)
+        chunk = self._bucket_for(cl)
+        tokens = np.zeros((1, chunk), np.int32)
         tokens[0, :cl] = piece
+        is_first = 1 if chunk_start == base else 0
+        W = self.ecfg.repeat_last_n
+        seed_row = np.full((1, W), -1, np.int32)
+        if is_first and chunk_start > 0:
+            # Cache hit: the penalty ring opens with the cached prefix's
+            # last W tokens, exactly as a full prefill would set it.
+            prev = req.prompt_tokens[max(0, chunk_start - W):chunk_start]
+            seed_row[0, W - len(prev):] = prev
         req.trace_event("prefill_chunk", pos=chunk_start, tokens=cl)
         t0 = time.monotonic()
         is_final = 1 if chunk_start + cl >= n else 0
         tok, self.kc, self.vc, self.recent = self._dispatch_chunk(
-            largest, tokens,
+            chunk, tokens,
             np.asarray([chunk_start], np.int32), np.asarray([cl], np.int32),
             np.asarray([slot], np.int32), np.asarray([is_final], np.int32),
+            np.asarray([is_first], np.int32), seed_row,
             req._pt_row,
             np.asarray([s.temperature], np.float32),
             np.asarray([s.top_k], np.int32),
@@ -1086,7 +1243,7 @@ class ModelRuntime:
         # Ensure page headroom for k_steps new tokens per active slot.
         for i in active:
             need = int(self.seq_lens[i]) + k_steps
-            if not self.alloc.extend(self.slot_pages[i], need):
+            if not self._extend_pages(self.slot_pages[i], need):
                 # Pool exhausted or per-seq cap: end this sequence here.
                 self._finish_slot(i, FinishReason.LENGTH, core)
             else:
@@ -1282,6 +1439,9 @@ class ModelRuntime:
             "mfu": round(self.mfu, 4),
             "param_bytes": self.param_bytes,
             "kv_bytes": self.kv_bytes,
+            # None = caching disabled (the TUI renders "cache n/a").
+            "prefix_cache": (self.prefix_cache.stats()
+                             if self.prefix_cache is not None else None),
         }
 
 
@@ -1371,6 +1531,7 @@ class EncoderRuntime:
             "mfu": 0.0,  # encoders don't publish decode-step MFU
             "param_bytes": self.param_bytes,
             "kv_bytes": self.kv_bytes,
+            "prefix_cache": None,  # encoders hold no KV to share
         }
 
 
@@ -1403,6 +1564,20 @@ def build_model_runtimes(name, cfg, engine_cfg, mesh, dtype, checkpoint_path,
         return reps
     return [model_cls(name, cfg, engine_cfg, mesh=mesh,
                       checkpoint_path=checkpoint_path, dtype=dtype)]
+
+
+def merge_prefix_cache_stats(stats_list) -> Optional[dict]:
+    """Sum per-replica prefix-cache stat dicts (None entries = replicas
+    without a cache). Returns None when no replica caches."""
+    live = [s for s in stats_list if s]
+    if not live:
+        return None
+    keys = ("hits", "misses", "evictions", "tokens_saved", "cached_pages",
+            "evictable_pages", "pinned_pages")
+    merged = {k: sum(s.get(k, 0) for s in live) for k in keys}
+    total = merged["hits"] + merged["misses"]
+    merged["hit_ratio"] = round(merged["hits"] / total, 4) if total else 0.0
+    return merged
 
 
 class ReplicaSet:
@@ -1499,6 +1674,8 @@ class ReplicaSet:
                     "prefill_latency_ms", "ttft_p50_ms", "ttft_p99_ms",
                     "mfu"):
             agg[key] = max(p.get(key, 0.0) for p in per)
+        agg["prefix_cache"] = merge_prefix_cache_stats(
+            [p.get("prefix_cache") for p in per])
         agg["replicas"] = len(per)
         return agg
 
@@ -2165,6 +2342,36 @@ class TPUEngine:
                 rt.reserved_slots.clear()
         except Exception:
             log.exception("error while failing runtime %s", rt.name)
+
+    # -- prefix cache (GET/POST /debug/prefix_cache) -----------------------
+    def prefix_cache_stats(self) -> dict:
+        """Per-model prefix-cache stats (replicas summed); works on any
+        engine subclass — runtimes without a cache are skipped."""
+        models: Dict[str, list] = {}
+        for rt in self._step_targets():
+            pc = getattr(rt, "prefix_cache", None)
+            if pc is not None:
+                models.setdefault(rt.name, []).append(pc.stats())
+        merged = {name: merge_prefix_cache_stats(reps)
+                  for name, reps in models.items()}
+        return {"enabled": bool(merged), "models": merged}
+
+    def prefix_cache_flush(self) -> int:
+        """Evict every unreferenced cached page on every runtime. Runs on
+        the engine thread: the tree and allocator are engine-loop state."""
+        def _do() -> int:
+            freed = 0
+            for rt in self._step_targets():
+                pc = getattr(rt, "prefix_cache", None)
+                if pc is not None:
+                    freed += pc.flush()
+            return freed
+
+        if not any(getattr(rt, "prefix_cache", None) is not None
+                   for rt in self._step_targets()):
+            return 0  # nothing to flush (also: FakeEngine's loop has no
+            #           call_on_loop drain — don't park on it)
+        return self.call_on_loop(_do)
 
     # -- telemetry ---------------------------------------------------------
     def chip_stats(self) -> List[dict]:

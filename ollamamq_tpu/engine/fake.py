@@ -153,6 +153,7 @@ class FakeRuntime:
             "mfu": 0.0,
             "param_bytes": self.param_bytes,
             "kv_bytes": self.kv_bytes,
+            "prefix_cache": None,  # fake tokens carry no KV to share
         }
 
 

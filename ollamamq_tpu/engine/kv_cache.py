@@ -29,13 +29,21 @@ TRASH_PAGE = 0
 
 
 class PageAllocator:
-    """Free-list allocator over page indices [1, num_pages)."""
+    """Free-list allocator over page indices [1, num_pages).
+
+    With the prefix cache enabled (engine/prefix_cache.py) every page is
+    exactly one of FREE (on the free list), USED (private to a decode
+    slot), or CACHED (owned by the radix tree, possibly pinned by live
+    requests); `cached_pages` tracks the third bucket so
+    free + used + cached == num_pages - 1 always holds.
+    """
 
     def __init__(self, num_pages: int, page_size: int, max_pages_per_seq: int):
         self.num_pages = num_pages
         self.page_size = page_size
         self.max_pages_per_seq = max_pages_per_seq
         self._free: List[int] = list(range(num_pages - 1, 0, -1))  # page 0 reserved
+        self.cached_pages = 0  # tree-owned (prefix cache accounting)
 
     @property
     def free_pages(self) -> int:
@@ -43,7 +51,7 @@ class PageAllocator:
 
     @property
     def used_pages(self) -> int:
-        return (self.num_pages - 1) - len(self._free)
+        return (self.num_pages - 1) - len(self._free) - self.cached_pages
 
     def pages_needed(self, num_tokens: int) -> int:
         return max(1, -(-num_tokens // self.page_size))
@@ -54,10 +62,27 @@ class PageAllocator:
     def alloc(self, num_tokens: int) -> Optional[List[int]]:
         """Allocate pages to hold num_tokens; None if pool exhausted or the
         request exceeds the per-sequence page cap."""
-        n = self.pages_needed(num_tokens)
-        if n > len(self._free) or n > self.max_pages_per_seq:
+        return self.alloc_n(self.pages_needed(num_tokens))
+
+    def alloc_n(self, n: int, held: int = 0) -> Optional[List[int]]:
+        """Allocate exactly n pages for a sequence already holding `held`
+        (cache-hit admission: shared prefix pages count against the
+        per-sequence cap but come from the tree, not the free list)."""
+        if n > len(self._free) or held + n > self.max_pages_per_seq:
             return None
         return [self._free.pop() for _ in range(n)]
+
+    # -- prefix-cache ownership transfer -----------------------------------
+    def adopt_cached(self, n: int = 1) -> None:
+        """A slot's page(s) moved into the prefix-cache tree: no longer
+        used, not free either."""
+        self.cached_pages += n
+
+    def reclaim_cached(self, page: int) -> None:
+        """An evicted tree page returns to the free list."""
+        self.cached_pages -= 1
+        if page != TRASH_PAGE:
+            self._free.append(page)
 
     def extend(self, pages: List[int], new_total_tokens: int) -> bool:
         """Grow an allocation to cover new_total_tokens. False if exhausted

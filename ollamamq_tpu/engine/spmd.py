@@ -287,11 +287,15 @@ def _decode_str(arr) -> str:
     return b.decode("utf-8")
 
 
-def payload_spec(op, a, b, S, MP):
+def payload_spec(op, a, b, S, MP, W):
     """[(shape, dtype), ...] for an opcode's broadcast payload — the ONE
     place the wire order lives. Senders cast their positional values to
     this spec; workers build a zeros template from it. Broadcast matches
-    on tree structure + shape/dtype, so both sides must agree exactly."""
+    on tree structure + shape/dtype, so both sides must agree exactly.
+    `W` is the repeat-penalty window (OP_CHUNK carries the first-chunk
+    penalty-ring seed row, which on a prefix-cache hit holds the cached
+    prefix's last W tokens — the tree itself is primary-only host state;
+    only its effects travel)."""
 
     def samp(n):  # temp, top_k, top_p, repeat, presence, frequency, seed
         return [((n,), np.float32), ((n,), np.int32), ((n,), np.float32),
@@ -304,8 +308,10 @@ def payload_spec(op, a, b, S, MP):
         return [((B, bucket), np.int32), ((B,), np.int32), ((B,), np.int32),
                 ((B, MP), np.int32)] + samp(B) + key
     if op == OP_CHUNK:
+        # tokens, start, chunk_len, slot, is_final, is_first, seed_row, pt
         return [((1, a), np.int32), ((1,), np.int32), ((1,), np.int32),
-                ((1,), np.int32), ((1,), np.int32),
+                ((1,), np.int32), ((1,), np.int32), ((1,), np.int32),
+                ((1, W), np.int32),
                 ((1, MP), np.int32)] + samp(1) + key
     if op == OP_DECODE:
         return [((S,), np.int32), ((S,), np.int32), ((S,), np.int32),
@@ -376,8 +382,8 @@ def _unpack_payload(raw: bytes, spec):
     return tuple(out)
 
 
-def _send(op, a, b, index, replica, values, S, MP):
-    spec = payload_spec(op, a, b, S, MP)
+def _send(op, a, b, index, replica, values, S, MP, W):
+    spec = payload_spec(op, a, b, S, MP, W)
     assert len(values) == len(spec)
     cast = []
     for v, (shape, dt) in zip(values, spec):
@@ -429,7 +435,7 @@ def broadcast_shutdown() -> None:
     """Release worker hosts. Sent exactly ONCE per deployment (the worker
     loop exits on the first shutdown header)."""
     if jax.process_count() > 1:
-        _send(OP_SHUTDOWN, 0, 0, 0, 0, (), 0, 0)
+        _send(OP_SHUTDOWN, 0, 0, 0, 0, (), 0, 0, 0)
 
 
 class _SyncBus:
@@ -501,7 +507,8 @@ def _mirrored_dispatch(rt, op, a, b, values, dispatch):
     generative and encoder SPMD runtimes so the sync protocol can't drift
     between them."""
     _send(op, a, b, rt.spmd_index, rt.spmd_replica, values,
-          rt.ecfg.max_slots, rt.ecfg.max_pages_per_seq)
+          rt.ecfg.max_slots, rt.ecfg.max_pages_per_seq,
+          rt.ecfg.repeat_last_n)
     ok = False
     try:
         out = dispatch()
@@ -555,18 +562,19 @@ class SPMDModelRuntime(ModelRuntime):
                 pen, pres, freq, seeds, key))
 
     def _dispatch_chunk(self, chunk, tokens, start, cl, slot_id, is_final,
-                        pt_row, temp, tk, tp, pen, pres, freq, seeds, key):
+                        is_first, seed_row, pt_row, temp, tk, tp, pen, pres,
+                        freq, seeds, key):
         if not self._spmd:
             return super()._dispatch_chunk(
-                chunk, tokens, start, cl, slot_id, is_final, pt_row, temp,
-                tk, tp, pen, pres, freq, seeds, key)
+                chunk, tokens, start, cl, slot_id, is_final, is_first,
+                seed_row, pt_row, temp, tk, tp, pen, pres, freq, seeds, key)
         return self._mirrored(
             OP_CHUNK, chunk, 0,
-            (tokens, start, cl, slot_id, is_final, pt_row, temp, tk, tp,
-             pen, pres, freq, seeds, key),
+            (tokens, start, cl, slot_id, is_final, is_first, seed_row,
+             pt_row, temp, tk, tp, pen, pres, freq, seeds, key),
             lambda: super(SPMDModelRuntime, self)._dispatch_chunk(
-                chunk, tokens, start, cl, slot_id, is_final, pt_row, temp,
-                tk, tp, pen, pres, freq, seeds, key))
+                chunk, tokens, start, cl, slot_id, is_final, is_first,
+                seed_row, pt_row, temp, tk, tp, pen, pres, freq, seeds, key))
 
     def _dispatch_decode(self, k_steps, tokens, positions, active, pt, temp,
                          tk, tp, pen, pres, freq, seeds, key):
@@ -689,7 +697,8 @@ class SPMDEngine:
                               (_encode_str(name, NAME_LEN),
                                _encode_str(checkpoint_path, PATH_LEN)),
                               self.ecfg.max_slots,
-                              self.ecfg.max_pages_per_seq)
+                              self.ecfg.max_pages_per_seq,
+                              self.ecfg.repeat_last_n)
                         ok = False
                         try:
                             super(_Engine, self).load_model(
@@ -728,7 +737,8 @@ class SPMDEngine:
                         _send(OP_EVICT, 0, 0, mi, 0,
                               (_encode_str(name, NAME_LEN),),
                               self.ecfg.max_slots,
-                              self.ecfg.max_pages_per_seq)
+                              self.ecfg.max_pages_per_seq,
+                              self.ecfg.repeat_last_n)
                         ok = False
                         try:
                             out = super(_Engine, self).evict_model(name)
@@ -767,7 +777,8 @@ class SPMDEngine:
                             "all hosts", rt.name, rt.spmd_index,
                             rt.spmd_replica)
                 _send(OP_RELOAD, 0, 0, rt.spmd_index, rt.spmd_replica, (),
-                      self.ecfg.max_slots, self.ecfg.max_pages_per_seq)
+                      self.ecfg.max_slots, self.ecfg.max_pages_per_seq,
+                      self.ecfg.repeat_last_n)
                 ok = False
                 try:
                     # Posts to _rebuilt on success; False = primary-side
@@ -894,6 +905,7 @@ def run_worker(
     steps = 0
     S = engine_cfg.max_slots
     MP = engine_cfg.max_pages_per_seq
+    W = engine_cfg.repeat_last_n
     DATA_OPS = (OP_PREFILL, OP_CHUNK, OP_DECODE, OP_PREFILL_SP, OP_ENCODE,
                 OP_EMBED)
 
@@ -906,7 +918,7 @@ def run_worker(
             break
         ok = True
         try:
-            payload = _unpack_payload(raw, payload_spec(op, a, b, S, MP))
+            payload = _unpack_payload(raw, payload_spec(op, a, b, S, MP, W))
             if op in DATA_OPS:
                 rt = _slot(replica_lists, specs, mi, ri)
                 if isinstance(rt, _DeadReplica):
@@ -1026,12 +1038,12 @@ def _replay(rt, op, a, b, payload):
         return (toks, rt.kc, rt.vc, rt.recent)
     elif op == OP_CHUNK:
         chunk = a
-        (tokens, start, cl, slot_id, is_final, pt_row, temp, tk, tp,
-         pen, pres, freq, seeds, key_data) = payload
+        (tokens, start, cl, slot_id, is_final, is_first, seed_row, pt_row,
+         temp, tk, tp, pen, pres, freq, seeds, key_data) = payload
         key = jnp.asarray(key_data, jnp.uint32)
         toks, rt.kc, rt.vc, rt.recent = ModelRuntime._dispatch_chunk(
-            rt, chunk, tokens, start, cl, slot_id, is_final, pt_row,
-            temp, tk, tp, pen, pres, freq, seeds, key)
+            rt, chunk, tokens, start, cl, slot_id, is_final, is_first,
+            seed_row, pt_row, temp, tk, tp, pen, pres, freq, seeds, key)
         return (toks, rt.kc, rt.vc, rt.recent)
     elif op == OP_DECODE:
         k_steps = a

@@ -103,6 +103,9 @@ class Server:
         r.add_route("GET", "/metrics.json", self.metrics_json)
         r.add_route("GET", "/debug/trace", self.debug_trace)
         r.add_route("POST", "/debug/profile", self.debug_profile)
+        r.add_route("GET", "/debug/prefix_cache", self.debug_prefix_cache)
+        r.add_route("POST", "/debug/prefix_cache",
+                    self.debug_prefix_cache_flush)
         if self.allow_all_routes:
             r.add_route("*", "/{tail:.*}", self.fallback)
         return app
@@ -299,6 +302,31 @@ class Server:
         if tracer is None:
             raise ApiError(501, "this engine does not trace requests")
         return web.json_response(tracer.export_chrome())
+
+    async def debug_prefix_cache(self, request: web.Request) -> web.Response:
+        """Prefix-cache stats per model: hit/miss/eviction counters,
+        tokens saved, cached/evictable/pinned page counts (replicas
+        summed). `enabled: false` when no runtime caches."""
+        self._ident(request)
+        fn = getattr(self.engine, "prefix_cache_stats", None)
+        if fn is None:
+            raise ApiError(501, "this engine has no prefix cache")
+        stats = await asyncio.get_running_loop().run_in_executor(None, fn)
+        return web.json_response(stats)
+
+    async def debug_prefix_cache_flush(self, request: web.Request) -> web.Response:
+        """Evict every unreferenced cached page (pinned prefixes of live
+        requests survive). Runs on the engine thread — the tree and the
+        page allocator are engine-loop state."""
+        self._ident(request)
+        fn = getattr(self.engine, "prefix_cache_flush", None)
+        if fn is None:
+            raise ApiError(501, "this engine has no prefix cache")
+        try:
+            freed = await asyncio.get_running_loop().run_in_executor(None, fn)
+        except Exception as e:
+            raise ApiError(500, f"prefix-cache flush failed: {e}")
+        return web.json_response({"status": "success", "freed_pages": freed})
 
     async def debug_profile(self, request: web.Request) -> web.Response:
         """Capture a jax.profiler trace of the live engine for N seconds

@@ -76,6 +76,32 @@ PROMPT_TOKENS_TOTAL = REGISTRY.counter(
     "ollamamq_prompt_tokens_total",
     "Prompt tokens prefilled across all requests", labels=("model",))
 
+# -- prefix cache (engine/prefix_cache.py; series exist only when
+# --prefix-cache is on) ----------------------------------------------------
+PREFIX_CACHE_HITS_TOTAL = REGISTRY.counter(
+    "ollamamq_prefix_cache_hits_total",
+    "Admissions that reused a cached prompt prefix (≥ min-pages match)",
+    labels=("model",))
+PREFIX_CACHE_MISSES_TOTAL = REGISTRY.counter(
+    "ollamamq_prefix_cache_misses_total",
+    "Admissions with no (or below-threshold) cached prefix",
+    labels=("model",))
+PREFIX_CACHE_EVICTIONS_TOTAL = REGISTRY.counter(
+    "ollamamq_prefix_cache_evictions_total",
+    "Cached KV pages evicted back to the free list (LRU, on allocator "
+    "pressure or flush)", labels=("model",))
+PREFIX_CACHE_HIT_RATIO = REGISTRY.gauge(
+    "ollamamq_prefix_cache_hit_ratio",
+    "Prefix-cache hits / lookups since start (0..1)", labels=("model",))
+PREFIX_CACHE_TOKENS_SAVED = REGISTRY.gauge(
+    "ollamamq_prefix_cache_tokens_saved",
+    "Cumulative prompt tokens served from cached KV pages instead of "
+    "recomputed", labels=("model",))
+PREFIX_CACHE_PAGES = REGISTRY.gauge(
+    "ollamamq_prefix_cache_pages",
+    "KV pages currently owned by the prefix-cache radix tree",
+    labels=("model",))
+
 # -- host / device ---------------------------------------------------------
 HBM_USED_BYTES = REGISTRY.gauge(
     "ollamamq_hbm_used_bytes",

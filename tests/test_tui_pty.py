@@ -154,6 +154,10 @@ def test_tui_admin_verbs_via_pty(tmp_path):
         assert t.wait_output(b"chip 0 (host 0)"), "per-chip rows missing"
         assert t.wait_output(b"chip 7 (host 1)"), "per-chip rows missing"
 
+        # No runtime caches here => the throughput line says "cache n/a"
+        # (a caching runtime renders a hit percentage instead).
+        assert t.wait_output(b"cache n/a"), "prefix-cache field missing"
+
         # Panel 1, first user (sorted: alice), VIP toggle => star glyph.
         t.send("\t")
         t.send("p")
