@@ -47,6 +47,7 @@ def _fallback_argv(model: str) -> list:
             "--ttft-samples", "2", "--sweep-chunks", "",
             "--shared-prefix", "2", "--shared-prefix-len", "64",
             "--shared-prefix-tail", "16",
+            "--slo-burst", "2", "--slo-burst-size", "4",
             "--init-timeout", "300"]
 
 
@@ -113,6 +114,14 @@ def main() -> int:
                         "a multiple of --page-size)")
     p.add_argument("--shared-prefix-tail", type=int, default=32,
                    help="per-user unique prompt tail in tokens")
+    p.add_argument("--slo-burst", type=int, default=4,
+                   help="bursts in the slo_burst scenario (bursty arrivals "
+                        "measured against a TTFT SLO, with latency "
+                        "attribution and burn rate reported); 0 disables")
+    p.add_argument("--slo-burst-size", type=int, default=8,
+                   help="requests arriving at once per burst")
+    p.add_argument("--slo-ttft-ms", type=float, default=250.0,
+                   help="TTFT objective for the slo_burst scenario (ms)")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU platform (smoke-testing the harness)")
     p.add_argument("--init-timeout", type=float, default=300.0,
@@ -505,6 +514,19 @@ def main() -> int:
         finally:
             rt.prefix_cache = None  # detach: rt state stays cache-free
 
+    # slo_burst scenario: bursty arrivals against a TTFT objective —
+    # where does the burst's latency actually go (queue vs prefill), and
+    # how fast does it burn the error budget? Anchors the SLO/attribution
+    # observability stack with real numbers.
+    slo_burst = None
+    if args.slo_burst > 0:
+        try:
+            slo_burst = _slo_burst_scenario(rt, core, args, rng, touch)
+        except Exception as e:  # never discard the decode numbers
+            slo_burst = {"error": f"{type(e).__name__}: {e}"}
+            print(f"# slo_burst scenario failed: {slo_burst['error']}",
+                  file=sys.stderr)
+
     result = {
         "metric": "decode_tok_per_s_per_chip",
         "value": round(tok_per_s, 1),
@@ -542,9 +564,103 @@ def main() -> int:
             result["embed_error"] = embed_error
     if shared_prefix is not None:
         result["shared_prefix"] = shared_prefix
+    if slo_burst is not None:
+        result["slo_burst"] = slo_burst
     run_done.set()
     print(json.dumps(result), flush=True)
     return 0
+
+
+def _slo_burst_scenario(rt, core, args, rng, touch):
+    """Bursty arrivals against a TTFT SLO on a drained runtime: each of
+    B bursts drops `--slo-burst-size` requests into the prefill queue at
+    once, then steps the engine until every request has its first token.
+    Requests carry real traces, so the report includes the latency
+    attribution breakdown (mean ms per phase — under a burst, queueing
+    behind batch-mates dominates) plus the burn rate against
+    --slo-ttft-ms at a 0.99 target. One warmup burst (compiles the
+    batched-prefill jit) is excluded from the recorded stats."""
+    import statistics
+    import time
+
+    from ollamamq_tpu.engine.request import FinishReason, Request
+    from ollamamq_tpu.ops.sampling import SamplingParams
+    from ollamamq_tpu.telemetry import attribution
+    from ollamamq_tpu.telemetry.slo import AlertManager, SLOEngine
+    from ollamamq_tpu.telemetry.tracing import Tracer
+
+    target = 0.99
+    tracer = Tracer(capacity=args.slo_burst * args.slo_burst_size + 8)
+    slo = SLOEngine(AlertManager(), ttft_ms=args.slo_ttft_ms, target=target)
+    hi = min(rt.cfg.vocab_size, 30000)
+
+    def drain():
+        for s, r in enumerate(rt.slot_req):
+            if r is not None:
+                rt._finish_slot(s, FinishReason.CANCELLED, core)
+
+    def run_burst(idx0, record):
+        reqs = []
+        for i in range(args.slo_burst_size):
+            prompt = rng.integers(3, hi, size=args.prompt_len).tolist()
+            req = Request(30000 + idx0 + i, f"burst{i}", rt.name, prompt,
+                          SamplingParams(max_tokens=10**9))
+            req._inc_decode = rt.tokenizer.make_incremental_decoder()
+            req.trace = tracer.begin(req.req_id, req.user, rt.name)
+            reqs.append(req)
+        # The burst lands at once; admission order is queue order.
+        for req in reqs:
+            req.trace_event("admit")
+            rt.pending_prefill.append(req)
+        while any(not r.stats.first_token_at for r in reqs):
+            progressed = rt.step_prefill(core)
+            progressed = rt.step_chunk(core) or progressed
+            touch("slo_burst")
+            if not progressed and not rt.chunking:
+                raise RuntimeError("slo_burst request never admitted "
+                                   "(slots/pages too small for the burst?)")
+        if record:
+            for req in reqs:
+                slo.record("ttft", req.stats.ttft_ms)
+        drain()  # finishes the traces (outcome: cancelled)
+        return [r.stats.ttft_ms for r in reqs]
+
+    drain()
+    run_burst(0, record=False)  # warmup: compiles the B=MAX batch jit
+    ttfts = []
+    t0 = time.monotonic()
+    for b in range(args.slo_burst):
+        ttfts.extend(run_burst((b + 1) * 1000, record=True))
+    elapsed_s = time.monotonic() - t0
+
+    # Attribution breakdown: mean per-phase ms over the recorded bursts'
+    # finished traces (warmup requests excluded by req_id).
+    phase_sums, n_traces = {}, 0
+    for tr in tracer.traces():
+        if not tr.finished or tr.req_id < 31000:
+            continue
+        n_traces += 1
+        for phase, ms in attribution.phase_totals(list(tr.events)).items():
+            phase_sums[phase] = phase_sums.get(phase, 0.0) + ms
+    violations = sum(1 for t in ttfts if t > args.slo_ttft_ms)
+    obj = slo.objectives["ttft"]
+    return {
+        "bursts": args.slo_burst,
+        "burst_size": args.slo_burst_size,
+        "slo_ttft_ms": args.slo_ttft_ms,
+        "target": target,
+        "elapsed_s": round(elapsed_s, 3),
+        "ttft_p50_ms": round(statistics.median(ttfts), 1),
+        "ttft_max_ms": round(max(ttfts), 1),
+        "violations": violations,
+        "violation_ratio": round(violations / max(1, len(ttfts)), 4),
+        # Burn over a window covering the whole run: ratio_bad / budget.
+        "burn_rate": round(obj.burn_rate(max(60.0, elapsed_s + 5)), 2),
+        "attribution_ms": {
+            p: round(phase_sums[p] / max(1, n_traces), 2)
+            for p in attribution.PHASES if p in phase_sums
+        },
+    }
 
 
 def _shared_prefix_scenario(rt, core, args, rng, touch):

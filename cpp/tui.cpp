@@ -252,7 +252,7 @@ struct Tui {
     // ---- three columns: chips/models | users | queues ----
     int col1 = cols * 35 / 100, col2 = cols * 35 / 100;
     int col3 = cols - col1 - col2 - 2;
-    int body = rows - 2 /*bars*/ - 6 /*blocked + headers*/;
+    int body = rows - 2 /*bars*/ - 6 /*blocked + headers*/ - 3 /*alerts*/;
     if (body < 4) body = 4;
 
     std::vector<std::string> c1 = render_models(stats, col1, body);
@@ -267,6 +267,10 @@ struct Tui {
       l += pad_visible(i < (int)c3.size() ? c3[i] : "", col3);
       line(l, cols);
     }
+
+    // ---- alerts (SLO burn-rate + stall watchdog, via the engine's
+    // shared alert table; ok when quiet, red rows when firing) ----
+    render_alerts(stats, cols);
 
     // ---- blocked items ----
     put(std::string(BOLD));
@@ -432,6 +436,51 @@ struct Tui {
     return out;
   }
 
+  void render_alerts(const mj::ValuePtr &stats, int cols) {
+    /* Fixed 3-row section (header + 2 rows) so the layout never jumps
+     * when alerts come and go. Overflow collapses into a "+N more". */
+    auto alerts = stats->get("alerts");
+    size_t n = alerts ? alerts->arr.size() : 0;
+    char hdr[64];
+    if (n > 0)
+      std::snprintf(hdr, sizeof hdr, "  ALERTS (%d firing)", (int)n);
+    else
+      std::snprintf(hdr, sizeof hdr, "  ALERTS");
+    put(std::string(BOLD) + (n > 0 ? RED : ""));
+    line(hdr, cols);
+    put(RST);
+    int shown = 0;
+    const int cap = 2;
+    if (alerts) {
+      for (auto &a : alerts->arr) {
+        if (shown >= cap) break;
+        std::string name = a->get("name") ? a->get("name")->as_str() : "?";
+        std::string sev =
+            a->get("severity") ? a->get("severity")->as_str() : "?";
+        std::string msg =
+            a->get("message") ? a->get("message")->as_str() : "";
+        long long age = a->get("age_s") ? a->get("age_s")->as_int() : 0;
+        char l[512];
+        std::snprintf(l, sizeof l, "  ⚠ [%s] %s (%llds): %s", sev.c_str(),
+                      name.c_str(), age, msg.c_str());
+        line(std::string(RED) + l + RST, cols);
+        ++shown;
+      }
+      if ((int)n > shown) {
+        char l[64];
+        std::snprintf(l, sizeof l, "    … +%d more alert(s)",
+                      (int)n - shown);
+        line(std::string(RED) + l + RST, cols);
+        ++shown;
+      }
+    }
+    if (shown == 0) {
+      line(std::string(DIM) + "  (none)" + RST, cols);
+      ++shown;
+    }
+    for (; shown < cap; ++shown) line("", cols);
+  }
+
   std::vector<std::string> render_users(const std::vector<UserRow> &users,
                                         const std::string &vip,
                                         const std::string &boost,
@@ -505,6 +554,7 @@ struct Tui {
       "    CHIPS/MODELS  model runtimes on the TPU: slots, step latency, HBM",
       "    USERS         fair-share state: ★VIP ⚡boost ✖blocked ▶processing ●queued",
       "    QUEUES        per-user queue depth (full bar = 20 requests)",
+      "    ALERTS        firing alerts: SLO burn-rate + stall watchdog",
       "    BLOCKED       persisted user/IP blocklist",
       "",
       "  press ? to return",

@@ -55,12 +55,26 @@ def _engine_stats_brief(engine) -> dict:
             pass
         _hbm_cache.update(ts=now, used=used, total=total, device=device,
                           chips=chips)
+    # Firing alerts (SLO burn, watchdog stalls, device loss) for the
+    # ALERTS panel — read from the engine's shared alert table at the
+    # frame cadence (an in-memory list copy; cheap).
+    alerts = []
+    am = getattr(engine, "alerts", None)
+    if am is not None:
+        try:
+            alerts = [{"name": a.name, "severity": a.severity,
+                       "message": a.message,
+                       "age_s": round(max(0.0, time.time() - a.since), 0)}
+                      for a in am.active()]
+        except Exception:
+            alerts = []
     return {
         "models": models,
         "device": _hbm_cache["device"] or "no-device",
         "chips": _hbm_cache["chips"],
         "hbm_used": _hbm_cache["used"],
         "hbm_total": _hbm_cache["total"],
+        "alerts": alerts,
     }
 
 

@@ -73,7 +73,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="GPipe microbatches per pp dispatch (0 = one per "
                         "stage; sweep on hardware — prefill wants more, "
                         "weight-bound decode may want fewer)")
+    # SLOs + alerting.
+    p.add_argument("--slo-ttft-ms", type=float, default=0.0,
+                   help="TTFT latency objective in ms (enqueue to first "
+                        "token); 0 = no TTFT SLO. Violations burn the "
+                        "error budget; multi-window burn-rate alerts "
+                        "surface in /health, /metrics, and the TUI")
+    p.add_argument("--slo-tpot-ms", type=float, default=0.0,
+                   help="per-token decode latency objective in ms; "
+                        "0 = no TPOT SLO")
+    p.add_argument("--slo-target", type=float, default=0.99,
+                   help="good-fraction target for both SLOs (0.99 = 1%% "
+                        "error budget)")
     # Telemetry.
+    p.add_argument("--log-file", default=os.environ.get("OLLAMAMQ_LOG_FILE",
+                                                        ""),
+                   help="write logs to this file as structured JSON lines "
+                        "(one object per line, request-scoped lines carry "
+                        "req_id). Default: ollamamq.log in CWD when the "
+                        "TUI owns the terminal, stdout otherwise")
     p.add_argument("--metrics-buckets", default="",
                    help="comma-separated upper bounds (ms) for the latency "
                         "histograms on /metrics (ttft/tpot/step/prefill); "
@@ -96,15 +114,44 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def setup_logging(use_tui: bool) -> None:
+class JsonLineFormatter(logging.Formatter):
+    """Structured log lines: one JSON object per line. Request-scoped
+    records (logged with extra={"req_id": N}) carry the id, so a log line
+    correlates directly with GET /debug/requests/{id}."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        import json
+
+        out = {
+            "ts": self.formatTime(record, "%Y-%m-%dT%H:%M:%S")
+            + f".{int(record.msecs):03d}Z",
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        rid = getattr(record, "req_id", None)
+        if rid is not None:
+            out["req_id"] = rid
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, ensure_ascii=False)
+
+
+def setup_logging(use_tui: bool, log_file: str = "") -> None:
+    """File logging (JSON lines) when --log-file names a path, or — TUI
+    owning the terminal with no explicit path — the reference's
+    ollamamq.log default; human-readable stdout otherwise."""
     level = os.environ.get("OLLAMAMQ_LOG", "INFO").upper()
-    if use_tui:
-        handler = logging.FileHandler("ollamamq.log")
+    if not log_file and use_tui:
+        log_file = "ollamamq.log"  # reference default (main.rs:66-87)
+    if log_file:
+        handler: logging.Handler = logging.FileHandler(log_file)
+        handler.setFormatter(JsonLineFormatter())
     else:
         handler = logging.StreamHandler(sys.stdout)
-    handler.setFormatter(logging.Formatter(
-        "%(asctime)s %(levelname)s %(name)s: %(message)s"
-    ))
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s: %(message)s"
+        ))
     logging.basicConfig(level=getattr(logging, level, logging.INFO),
                         handlers=[handler])
 
@@ -112,8 +159,11 @@ def setup_logging(use_tui: bool) -> None:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     use_tui = not args.no_tui and sys.stdout.isatty()
-    setup_logging(use_tui)
+    setup_logging(use_tui, log_file=args.log_file)
     log = logging.getLogger("ollamamq")
+    if not (0.0 < args.slo_target < 1.0):
+        log.error("--slo-target must be in (0, 1), got %s", args.slo_target)
+        return 2
 
     if args.cpu:
         from ollamamq_tpu.parallel.distributed import multiprocess_configured
@@ -174,6 +224,9 @@ def main(argv=None) -> int:
         ep=args.ep,
         pp_microbatches=args.pp_microbatches or None,
         trace_ring=args.trace_ring,
+        slo_ttft_ms=args.slo_ttft_ms or None,
+        slo_tpot_ms=args.slo_tpot_ms or None,
+        slo_target=args.slo_target,
     )
     fairness = Fairness.TOKENS if args.token_fairness else Fairness.REQUESTS
 

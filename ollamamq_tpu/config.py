@@ -248,6 +248,13 @@ class EngineConfig:
     # Telemetry: finished request traces kept for GET /debug/trace
     # (Chrome trace-event export); in-flight traces are always exported.
     trace_ring: int = 512
+    # Latency SLOs (telemetry/slo.py): 0/None = objective not configured.
+    # slo_ttft_ms bounds enqueue -> first token; slo_tpot_ms bounds the
+    # per-token decode step. slo_target is the good-fraction objective
+    # (0.99 = 1% error budget); burn-rate alerts fire against it.
+    slo_ttft_ms: Optional[float] = None
+    slo_tpot_ms: Optional[float] = None
+    slo_target: float = 0.99
 
     @property
     def max_context(self) -> int:

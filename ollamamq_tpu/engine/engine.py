@@ -53,6 +53,7 @@ from ollamamq_tpu.parallel.mesh import (make_mesh, replica_submesh,
 from ollamamq_tpu.parallel.sharding import kv_cache_spec, shard_params
 from ollamamq_tpu.telemetry import mfu as mfu_model
 from ollamamq_tpu.telemetry import schema as tm
+from ollamamq_tpu.telemetry.slo import AlertManager, SLOEngine
 from ollamamq_tpu.telemetry.tracing import DECODE_EVENT_EVERY, Tracer
 
 log = logging.getLogger("ollamamq.engine")
@@ -189,6 +190,11 @@ class ModelRuntime:
     # backends compute embeddings from causal models (llama.cpp mean
     # pooling), so embed-on-llama3 must work here too (README /api/embed).
     SERVES = ("generate", "embed")
+
+    # SLO recording hook (telemetry/slo.py SLOEngine), attached by the
+    # owning engine's load_model/_swap_rebuilt. None on SPMD worker
+    # hosts' replay runtimes — SLO accounting is primary-only.
+    slo = None
 
     def __init__(
         self,
@@ -714,7 +720,7 @@ class ModelRuntime:
             # would miss them since slot_req[slot] is still None — and keep
             # every other in-flight request alive.
             log.exception("sequence-parallel prefill failed for req %d",
-                          req.req_id)
+                          req.req_id, extra={"req_id": req.req_id})
             self._release_slot_pages(slot)
             core.mark_dropped(req.user)
             req.finish(FinishReason.ERROR, error=f"sp prefill failed: {e}")
@@ -836,6 +842,8 @@ class ModelRuntime:
             req.stats.first_token_at = time.monotonic()
             self.ttft_window.append(req.stats.ttft_ms)
             self._tm_ttft.observe(req.stats.ttft_ms)
+            if self.slo is not None:
+                self.slo.record("ttft", req.stats.ttft_ms)
             req.trace_event("first_token", ttft_ms=round(req.stats.ttft_ms, 3))
         elif len(req.generated_ids) % DECODE_EVENT_EVERY == 0:
             req.trace_event("decode", tokens=len(req.generated_ids))
@@ -846,6 +854,17 @@ class ModelRuntime:
             return False
         if chunk:
             req.stream.push(StreamItem("token", text=chunk, token_id=tok))
+        # Stream-write stall attribution: a consumer backlog above the
+        # high-water mark opens a "stream" span on the trace; dropping
+        # back under closes it. Transition-edged so the event cap isn't
+        # chewed up by a persistently slow reader.
+        depth = req.stream.depth()
+        if not req._stream_stalled and depth >= req.stream.high_water:
+            req._stream_stalled = True
+            req.trace_event("stream_stall", depth=depth)
+        elif req._stream_stalled and depth < req.stream.high_water // 2:
+            req._stream_stalled = False
+            req.trace_event("stream_resume", depth=depth)
         if len(req.generated_ids) >= req.sampling.max_tokens:
             self._finish_slot(slot, FinishReason.LENGTH, core)
             return False
@@ -1324,6 +1343,12 @@ class ModelRuntime:
         # TPOT: every active slot gains one token per step, so step
         # latency IS time-per-output-token for each stream in the batch.
         self._tm_tpot.observe(self.step_latency_ms)
+        if self.slo is not None:
+            # One SLO observation per emitted token, not per chunk: the
+            # objective is per-token latency and the budget math needs
+            # event counts that match what users experienced.
+            self.slo.record("tpot", self.step_latency_ms,
+                            n=max(1, len(active) * k_steps))
 
         emitted = 0
         for k in range(k_steps):
@@ -1449,6 +1474,8 @@ class EncoderRuntime:
 
     SERVES = ("embed",)
     """Embedding model runtime: batch encode, no KV cache."""
+
+    slo = None  # encoders emit no tokens; attached but never recorded into
 
     def __init__(self, name, model_cfg, engine_cfg, mesh=None,
                  checkpoint_path=None, dtype=jnp.bfloat16):
@@ -1725,6 +1752,19 @@ class TPUEngine:
         # Request-lifecycle tracing: bounded ring of finished traces plus
         # the in-flight table, exported at GET /debug/trace.
         self.tracer = Tracer(capacity=engine_cfg.trace_ring)
+        # Alerting + SLO burn-rate engine: the one alert table /health,
+        # /metrics, /debug/bundle, and the TUI alerts panel all read.
+        # Objectives are opt-in (--slo-ttft-ms / --slo-tpot-ms); the
+        # alert table exists regardless — the stall watchdog uses it too.
+        self.alerts = AlertManager()
+        self.slo = SLOEngine(self.alerts,
+                             ttft_ms=engine_cfg.slo_ttft_ms or None,
+                             tpot_ms=engine_cfg.slo_tpot_ms or None,
+                             target=engine_cfg.slo_target)
+        # Engine-loop liveness tick for the stall watchdog: bumped at the
+        # top of every _loop_once, so a dispatch wedged inside a step
+        # leaves it stale while work is pending.
+        self.last_tick_at = time.monotonic()
         # CPU-gloo can't run two cross-host computations concurrently: XLA's
         # CPU thread pool executes them in nondeterministic order and their
         # collective ops interleave differently per process on the shared
@@ -1759,6 +1799,8 @@ class TPUEngine:
             name, cfg, self.ecfg, self.mesh, self.dtype, checkpoint_path,
             self.runtime_class, self.encoder_runtime_class,
         )
+        for rep in reps:
+            rep.slo = self.slo  # primary-side SLO accounting hook
         self.runtimes[name] = reps[0] if len(reps) == 1 else ReplicaSet(reps)
         log.info("loaded model %s (%.1f MB params)", name,
                  self.runtimes[name].param_bytes / 1e6)
@@ -2157,6 +2199,7 @@ class TPUEngine:
                 time.sleep(0.1)
 
     def _loop_once(self) -> None:
+        self.last_tick_at = time.monotonic()
         self._drain_engine_calls()
         self._swap_rebuilt()
         if (self._failed_runtimes
@@ -2298,6 +2341,7 @@ class TPUEngine:
                 return
             items, self._rebuilt = self._rebuilt, []
         for rt, fresh in items:
+            fresh.slo = self.slo
             if hasattr(rt, "spmd_index"):
                 fresh.spmd_index = rt.spmd_index
                 fresh.spmd_replica = getattr(rt, "spmd_replica", 0)
@@ -2382,6 +2426,12 @@ class TPUEngine:
     def worker_metric_snapshots(self) -> List[dict]:
         """Peer-host registry snapshots to merge into /metrics; the SPMD
         engine overrides to read them off the KV store."""
+        return []
+
+    def stale_worker_hosts(self) -> List[int]:
+        """Process ids of SPMD worker hosts whose KV-store snapshots have
+        stopped advancing; the stall watchdog alerts on them. The SPMD
+        engine overrides — single-host engines have no peers."""
         return []
 
     def stats(self) -> dict:

@@ -23,6 +23,8 @@ from ollamamq_tpu.telemetry import schema as tm
 class FakeRuntime:
     """Generates `word0 word1 ...` tokens, one per step, per active request."""
 
+    slo = None  # attached by FakeEngine.load_model, like ModelRuntime
+
     def __init__(self, name: str, engine_cfg: EngineConfig,
                  token_latency_s: float = 0.0, is_encoder: bool = False):
         # Kind gate (engine._place): encoder fakes are embedding-only, like
@@ -110,8 +112,12 @@ class FakeRuntime:
                 req.stats.first_token_at = time.monotonic()
                 self._tm_ttft.observe(req.stats.ttft_ms)
                 self._tm_tpot.observe(self.token_latency_s * 1e3)
+                if self.slo is not None:
+                    self.slo.record("ttft", req.stats.ttft_ms)
                 req.trace_event("first_token",
                                 ttft_ms=round(req.stats.ttft_ms, 3))
+            elif self.slo is not None:
+                self.slo.record("tpot", self.token_latency_s * 1e3)
             chunk = req.emit_text(word)
             if chunk is None:
                 self.active.remove(req)
@@ -174,13 +180,16 @@ class FakeEngine(TPUEngine):
             return
         cfg = get_model_config(name)
         is_enc = bool(cfg and cfg.is_encoder)
-        self.runtimes[name] = FakeRuntime(
+        rt = FakeRuntime(
             name, self.ecfg, token_latency_s=self.token_latency_s, is_encoder=is_enc
         )
+        rt.slo = self.slo
+        self.runtimes[name] = rt
         self.notify()
 
     def _loop(self) -> None:
         while self._running:
+            self.last_tick_at = time.monotonic()
             self._admit()
             did_work = False
             for rt in list(self.runtimes.values()):

@@ -1,4 +1,5 @@
-"""Health monitor: device liveness + engine-step watchdog.
+"""Health monitor + stall watchdog: device liveness, engine progress,
+stuck requests, stale SPMD workers — all raised as alerts.
 
 The reference polls each backend every 10 s (GET /api/tags | /api/ps | /
 — dispatcher.rs:261-387) and logs online/offline transitions. The TPU
@@ -6,12 +7,20 @@ analogue watches the things that can actually fail here:
 
   - device liveness: a trivial jitted op must complete within a deadline
     (a wedged TPU runtime/tunnel hangs rather than erroring);
-  - engine progress: if work exists but no step has completed recently,
-    the engine is stalled — logged loudly, surfaced in /metrics;
+  - engine-step progress: work exists but no token has been produced —
+    or the engine loop's liveness tick has gone stale (a dispatch wedged
+    INSIDE a step blocks the loop thread without erroring);
+  - requests stuck in a phase: an in-flight trace whose last lifecycle
+    event is older than the deadline (the phase it is stuck in reads
+    straight off the attribution layer);
+  - SPMD worker hosts whose KV-store heartbeats stopped advancing;
   - HBM headroom: page-pool exhaustion pressure.
 
-Transitions are logged like the reference's "Backend ... is now ONLINE /
-OFFLINE" messages; the TUI and /metrics read `status()`.
+Every detection raises a named alert through the engine's AlertManager
+(telemetry/slo.py) — the same table the SLO burn-rate evaluator feeds —
+so /health, /metrics (`ollamamq_slo_alerts_firing`), /debug/bundle, and
+the TUI alerts panel all show one consistent picture. Transitions are
+logged like the reference's "Backend ... is now ONLINE / OFFLINE".
 """
 
 from __future__ import annotations
@@ -20,23 +29,46 @@ import logging
 import threading
 import time
 
+from ollamamq_tpu.telemetry import schema as tm
+from ollamamq_tpu.telemetry.attribution import phase_of
+
 log = logging.getLogger("ollamamq.health")
 
 CHECK_PERIOD_S = 10.0  # reference cadence (dispatcher.rs:385)
 DEVICE_DEADLINE_S = 30.0
 STALL_DEADLINE_S = 30.0
+# A request whose trace has not moved to a new lifecycle event in this
+# long is stuck-in-phase. Generous: a long chunked prefill emits an event
+# per chunk and a decode stream an event every 16 tokens, so any healthy
+# request beats this by orders of magnitude.
+REQUEST_STALL_S = 120.0
 
 
 class HealthMonitor:
-    def __init__(self, engine, period_s: float = CHECK_PERIOD_S):
+    def __init__(self, engine, period_s: float = CHECK_PERIOD_S,
+                 stall_s: float | None = None,
+                 request_stall_s: float | None = None):
         self.engine = engine
         self.period_s = period_s
+        # None = read the module globals at check time (tests monkeypatch
+        # those); an explicit value pins this instance.
+        self._stall_s = stall_s
+        self._request_stall_s = request_stall_s
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
         self.device_online = True
         self.engine_stalled = False
         self.last_device_check = 0.0
         self._last_progress = (0, time.monotonic())  # (tokens, ts)
+
+    @property
+    def stall_s(self) -> float:
+        return self._stall_s if self._stall_s is not None else STALL_DEADLINE_S
+
+    @property
+    def request_stall_s(self) -> float:
+        return (self._request_stall_s if self._request_stall_s is not None
+                else REQUEST_STALL_S)
 
     def start(self) -> None:
         if self._thread:
@@ -51,6 +83,20 @@ class HealthMonitor:
             self._thread = None
 
     # ------------------------------------------------------------------
+    def _alert(self, name: str, firing: bool, severity: str, message: str,
+               kind: str) -> None:
+        """Raise/clear one watchdog alert; the firing transition counts
+        into ollamamq_watchdog_stalls_total{kind}. No-op on engines
+        without an alert table (unit-test stubs)."""
+        alerts = getattr(self.engine, "alerts", None)
+        if alerts is None:
+            return
+        if firing:
+            if alerts.fire(name, severity, message, source="watchdog"):
+                tm.WATCHDOG_STALLS_TOTAL.labels(kind=kind).inc()
+        else:
+            alerts.resolve(name)
+
     def _probe_device(self) -> bool:
         """Run a trivial computation with a deadline on a side thread — a
         hung runtime must not take the monitor down with it. While a probe
@@ -92,33 +138,102 @@ class HealthMonitor:
         if tokens != last_tokens or not has_work:
             self._last_progress = (tokens, now)
             return True
-        return (now - last_ts) < STALL_DEADLINE_S
+        if (now - last_ts) < self.stall_s:
+            return True
+        # No token for stall_s with work pending. Distinguish "loop alive
+        # but starved" from "loop thread wedged inside a dispatch": the
+        # liveness tick at the top of _loop_once goes stale in the latter.
+        tick = getattr(self.engine, "last_tick_at", None)
+        if tick is not None and (now - tick) > self.stall_s:
+            return False  # loop thread itself is stuck
+        return False
+
+    def _check_stuck_requests(self) -> list:
+        """In-flight traces whose last lifecycle event is older than the
+        request-stall deadline: (req_id, phase, age_s) rows, worst first."""
+        tracer = getattr(self.engine, "tracer", None)
+        if tracer is None:
+            return []
+        now = time.monotonic()
+        out = []
+        for tr in tracer.traces():
+            if tr.finished:
+                continue
+            evs = tr.events  # engine thread appends; index reads are safe
+            if not evs:
+                continue
+            name, t = evs[-1][0], evs[-1][1]
+            age = now - t
+            if age > self.request_stall_s:
+                out.append((tr.req_id, phase_of(name), age))
+        out.sort(key=lambda r: -r[2])
+        return out
 
     def _loop(self) -> None:
         while not self._stop.wait(self.period_s):
             try:
-                ok = self._probe_device()
-                if ok != self.device_online:
-                    if ok:
-                        log.info("TPU device is back ONLINE")
-                    else:
-                        log.error("TPU device probe FAILED (runtime hung or lost)")
-                    self.device_online = ok
-
-                progressing = self._check_progress()
-                if not progressing and not self.engine_stalled:
-                    log.error(
-                        "engine STALLED: %d queued, work pending, no tokens for %ds",
-                        self.engine.core.total_queued(), int(STALL_DEADLINE_S),
-                    )
-                self.engine_stalled = not progressing
+                self.check_once()
             except Exception:
                 # The watchdog must outlive anything it watches.
                 log.exception("health check iteration failed")
 
+    def check_once(self) -> None:
+        """One full watchdog pass (the loop cadence; callable directly in
+        tests)."""
+        ok = self._probe_device()
+        if ok != self.device_online:
+            if ok:
+                log.info("TPU device is back ONLINE")
+            else:
+                log.error("TPU device probe FAILED (runtime hung or lost)")
+            self.device_online = ok
+        self._alert("device_offline", not ok, "page",
+                    "device probe failed: runtime hung or lost", "device")
+
+        progressing = self._check_progress()
+        if not progressing and not self.engine_stalled:
+            log.error(
+                "engine STALLED: %d queued, work pending, no tokens for %ds",
+                self.engine.core.total_queued(), int(self.stall_s),
+            )
+        self.engine_stalled = not progressing
+        self._alert(
+            "engine_stall", self.engine_stalled, "page",
+            f"work pending but no token produced for {self.stall_s:g}s "
+            "(wedged engine step?)", "engine_step")
+
+        stuck = self._check_stuck_requests()
+        for r, p, a in stuck:
+            # req_id rides as a structured field so the JSON log line
+            # correlates with /debug/requests/{id}.
+            log.error("request %d stuck in phase '%s' for %.0fs",
+                      r, p, a, extra={"req_id": r})
+        self._alert(
+            "request_stall", bool(stuck), "warn",
+            (f"{len(stuck)} request(s) stuck; worst: req {stuck[0][0]} in "
+             f"'{stuck[0][1]}' for {stuck[0][2]:.0f}s") if stuck else "",
+            "request_phase")
+
+        stale = []
+        hosts_fn = getattr(self.engine, "stale_worker_hosts", None)
+        if hosts_fn is not None:
+            stale = hosts_fn() or []
+        self._alert(
+            "worker_stale", bool(stale), "page",
+            f"SPMD worker host(s) {stale} stopped publishing registry "
+            "snapshots/heartbeats", "worker_host")
+
+        slo = getattr(self.engine, "slo", None)
+        if slo is not None:
+            slo.evaluate()
+
     def status(self) -> dict:
+        alerts = getattr(self.engine, "alerts", None)
+        active = alerts.active() if alerts is not None else []
         return {
+            "status": "degraded" if active else "ok",
             "device_online": self.device_online,
             "engine_stalled": self.engine_stalled,
             "last_device_check": self.last_device_check,
+            "alerts": [a.to_dict() for a in active],
         }

@@ -49,6 +49,11 @@ class TokenStream:
     def __init__(self, maxsize: int = 1024):
         self._q: "queue.Queue[StreamItem]" = queue.Queue(maxsize=maxsize)
         self.on_item: Optional[Callable[[], None]] = None
+        # Consumer-not-draining threshold: the engine marks the request's
+        # trace with a stream_stall span when the backlog crosses this
+        # (latency attribution's "stream" phase) — well before the hard
+        # overflow below turns it into a disconnect.
+        self.high_water = max(1, maxsize // 2)
         self._closed = False
         # Set when the consumer stops reading and the queue fills: the engine
         # treats it as a client disconnect (the reference likewise interprets
@@ -81,6 +86,9 @@ class TokenStream:
         cb = self.on_item
         if cb is not None:
             cb()
+
+    def depth(self) -> int:
+        return self._q.qsize()
 
     def get(self, timeout: Optional[float] = None) -> Optional[StreamItem]:
         try:
@@ -149,6 +157,9 @@ class Request:
         # engine's enqueue path; None for directly-constructed Requests
         # (bench, unit tests) — every trace hook below no-ops then.
         self.trace = None
+        # Stream-stall attribution state (engine-owned): True while the
+        # consumer's backlog sits above the TokenStream high-water mark.
+        self._stream_stalled = False
         # Generation state (engine-owned):
         self.generated_ids: List[int] = []
         self.emitted_len = 0  # chars of detok text already pushed

@@ -814,6 +814,21 @@ class SPMDEngine:
                                               c.get("id", 0)))
                 return chips
 
+            def stale_worker_hosts(self):
+                """Worker hosts whose heartbeat value stopped advancing
+                (same staleness rule the status sync uses — the shared
+                module-level monitor keeps one view of peer liveness, so
+                the watchdog and the sync can never disagree)."""
+                if jax.process_count() <= 1:
+                    return []
+                me = jax.process_index()
+                try:
+                    return _hb_monitor.stale_peers(
+                        [p for p in range(jax.process_count()) if p != me])
+                except Exception:
+                    return []  # coordinator unreachable: the sync path
+                    #            will surface that loudly on its own
+
             def worker_metric_snapshots(self):
                 if jax.process_count() <= 1:
                     return []

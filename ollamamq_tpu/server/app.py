@@ -51,6 +51,30 @@ def _now_iso() -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime()) + ".000000000Z"
 
 
+# Key substrings whose values never belong in a diagnostics bundle. The
+# bundle is built to be pasted into tickets/chat — redact by KEY (the only
+# reliable signal; value sniffing misses short secrets and false-positives
+# on hashes).
+_SECRET_KEY_MARKERS = ("token", "secret", "password", "passwd", "api_key",
+                       "apikey", "credential", "auth", "cookie", "private")
+
+
+def _redact(obj):
+    """Recursively replace secret-shaped mapping values with a marker."""
+    if isinstance(obj, dict):
+        out = {}
+        for k, v in obj.items():
+            kl = str(k).lower()
+            if any(m in kl for m in _SECRET_KEY_MARKERS):
+                out[k] = "[REDACTED]"
+            else:
+                out[k] = _redact(v)
+        return out
+    if isinstance(obj, (list, tuple)):
+        return [_redact(v) for v in obj]
+    return obj
+
+
 def _ns(seconds: float) -> int:
     return int(seconds * 1e9)
 
@@ -98,10 +122,14 @@ class Server:
         r.add_route("*", "/v1/models", self.v1_models)
         r.add_route("*", "/v1/models/{model}", self.v1_model)
         # TPU-era observability: Prometheus exposition, the legacy JSON
-        # payload (TUI / scripts), and Chrome trace-event request traces.
+        # payload (TUI / scripts), Chrome trace-event request traces,
+        # latency attribution, and the one-shot diagnostics bundle.
         r.add_route("GET", "/metrics", self.metrics)
         r.add_route("GET", "/metrics.json", self.metrics_json)
         r.add_route("GET", "/debug/trace", self.debug_trace)
+        r.add_route("GET", "/debug/requests", self.debug_requests)
+        r.add_route("GET", "/debug/requests/{req_id}", self.debug_request)
+        r.add_route("GET", "/debug/bundle", self.debug_bundle)
         r.add_route("POST", "/debug/profile", self.debug_profile)
         r.add_route("GET", "/debug/prefix_cache", self.debug_prefix_cache)
         r.add_route("POST", "/debug/prefix_cache",
@@ -227,7 +255,19 @@ class Server:
 
     # ------------------------------------------------------------ liveness
     async def health(self, request: web.Request) -> web.Response:
-        return web.Response(text="OK")
+        """Liveness + degradation. Always 200 (degraded != dead: an LB
+        must not evict the only replica because an SLO is burning); the
+        body carries "ok"/"degraded" plus every firing alert — SLO burn,
+        watchdog stalls, device loss — from the shared alert table.
+        Stays open to blocked users, like the reference's /health."""
+        alerts = getattr(self.engine, "alerts", None)
+        if alerts is None:
+            return web.json_response({"status": "ok", "alerts": []})
+        active = [a.to_dict() for a in alerts.active()]
+        return web.json_response({
+            "status": "degraded" if active else "ok",
+            "alerts": active,
+        })
 
     async def root(self, request: web.Request) -> web.Response:
         # Ollama answers its root with this exact liveness string; clients
@@ -280,6 +320,17 @@ class Server:
                 tm.HBM_TOTAL_BYTES.labels(**lab).set(c.get("hbm_total", 0))
         except Exception:
             log.exception("chip-stats scrape failed")
+        # Active alerts: rebuilt each scrape so resolved alerts' series
+        # disappear instead of lingering at 1.
+        try:
+            tm.SLO_ALERTS_FIRING.clear()
+            alerts = getattr(eng, "alerts", None)
+            if alerts is not None:
+                for a in alerts.active():
+                    tm.SLO_ALERTS_FIRING.labels(
+                        alert=a.name, severity=a.severity).set(1)
+        except Exception:
+            log.exception("alert scrape failed")
         extra = []
         try:
             extra = eng.worker_metric_snapshots()
@@ -302,6 +353,91 @@ class Server:
         if tracer is None:
             raise ApiError(501, "this engine does not trace requests")
         return web.json_response(tracer.export_chrome())
+
+    async def debug_requests(self, request: web.Request) -> web.Response:
+        """Latency attribution index: every in-flight request (with its
+        current phase and how long it has sat there) plus the most recent
+        finished timelines. `?recent=N` bounds the finished list."""
+        self._ident(request)
+        tracer = getattr(self.engine, "tracer", None)
+        if tracer is None:
+            raise ApiError(501, "this engine does not trace requests")
+        from ollamamq_tpu.telemetry import attribution
+
+        try:
+            recent = int(request.query.get("recent", "50"))
+        except ValueError:
+            raise ApiError(400, "'recent' must be an integer")
+        return web.json_response(attribution.summarize(tracer, recent=recent))
+
+    async def debug_request(self, request: web.Request) -> web.Response:
+        """Full phase timeline for one request: per-phase milliseconds
+        (summing to wall-clock e2e) plus the raw lifecycle events."""
+        self._ident(request)
+        tracer = getattr(self.engine, "tracer", None)
+        if tracer is None:
+            raise ApiError(501, "this engine does not trace requests")
+        try:
+            rid = int(request.match_info["req_id"])
+        except ValueError:
+            raise ApiError(400, "request id must be an integer")
+        tr = tracer.find(rid)
+        if tr is None:
+            raise ApiError(404, f"no trace for request {rid} (expired from "
+                                "the ring, or never existed)")
+        from ollamamq_tpu.telemetry import attribution
+
+        return web.json_response(attribution.timeline(tr))
+
+    async def debug_bundle(self, request: web.Request) -> web.Response:
+        """One-shot diagnostics bundle: config, metrics, request
+        timelines, prefix-cache stats, SLO state, and the alert table in
+        a single JSON document — what an operator attaches to an incident
+        before restarting anything. Secret-shaped values are redacted."""
+        self._ident(request)
+        bundle = await asyncio.get_running_loop().run_in_executor(
+            None, self._build_bundle)
+        return web.json_response(bundle)
+
+    def _build_bundle(self) -> dict:
+        import dataclasses
+        import os
+
+        eng = self.engine
+        bundle: dict = {
+            "generated_at": _now_iso(),
+            "version": __version__,
+            "uptime_s": round(time.time() - eng.started_at, 1),
+        }
+
+        def section(name, fn):
+            # Every section is error-contained: a diagnostics endpoint
+            # that throws while the engine is sick is worse than useless.
+            try:
+                bundle[name] = fn()
+            except Exception as e:  # noqa: BLE001
+                bundle[name] = {"error": f"{type(e).__name__}: {e}"}
+
+        section("config", lambda: _redact(dataclasses.asdict(eng.ecfg)))
+        section("env", lambda: _redact({
+            k: v for k, v in os.environ.items()
+            if k.startswith(("OLLAMAMQ_", "JAX_", "TPU_"))}))
+        section("models", eng.loaded_models)
+        section("stats", eng.stats)
+        section("health", lambda: (eng.health.status() if eng.health
+                                   else None))
+        section("alerts", lambda: eng.alerts.to_dict())
+        section("slo", lambda: eng.slo.summary())
+        section("metrics", self._render_prometheus)
+        if getattr(eng, "tracer", None) is not None:
+            from ollamamq_tpu.telemetry import attribution
+
+            section("requests",
+                    lambda: attribution.summarize(eng.tracer, recent=50))
+        pc = getattr(eng, "prefix_cache_stats", None)
+        if pc is not None:
+            section("prefix_cache", pc)
+        return bundle
 
     async def debug_prefix_cache(self, request: web.Request) -> web.Response:
         """Prefix-cache stats per model: hit/miss/eviction counters,

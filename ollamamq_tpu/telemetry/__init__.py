@@ -4,22 +4,27 @@ Dependency-free (stdlib only — no jax, no numpy): the same module serves
 the engine hot path, the HTTP exposition layer, worker-host snapshot
 publishing under SPMD, and the doc-consistency checker in CI.
 
-  metrics.py  process-wide registry (counters / gauges / fixed-bucket
-              histograms) + Prometheus text exposition + mergeable
-              snapshots for multi-host aggregation
-  schema.py   THE declaration site for every ollamamq_* metric — imported
-              by the engine/server for handles and by
-              scripts/check_metrics_docs.py for enumeration
-  tracing.py  request-lifecycle span traces in a bounded ring buffer,
-              exported as Chrome trace-event JSON (/debug/trace)
-  mfu.py      analytic FLOPs-per-token model + per-chip peak FLOPs table
+  metrics.py      process-wide registry (counters / gauges / fixed-bucket
+                  histograms) + Prometheus text exposition + mergeable
+                  snapshots for multi-host aggregation
+  schema.py       THE declaration site for every ollamamq_* metric —
+                  imported by the engine/server for handles and by
+                  scripts/check_metrics_docs.py for enumeration
+  tracing.py      request-lifecycle span traces in a bounded ring buffer,
+                  exported as Chrome trace-event JSON (/debug/trace)
+  attribution.py  per-request latency attribution: phase timelines from
+                  trace events (/debug/requests, /debug/requests/{id})
+  slo.py          SLO objectives + multi-window burn-rate alerting + the
+                  process-wide alert table (/health, TUI alerts panel)
+  mfu.py          analytic FLOPs-per-token model + per-chip peak FLOPs
 """
 
 from ollamamq_tpu.telemetry.metrics import (Counter, Gauge, Histogram,
                                             MetricsRegistry, REGISTRY)
+from ollamamq_tpu.telemetry.slo import Alert, AlertManager, SLOEngine
 from ollamamq_tpu.telemetry.tracing import Trace, Tracer
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "MetricsRegistry", "REGISTRY",
-    "Trace", "Tracer",
+    "Alert", "AlertManager", "Counter", "Gauge", "Histogram",
+    "MetricsRegistry", "REGISTRY", "SLOEngine", "Trace", "Tracer",
 ]

@@ -102,6 +102,35 @@ PREFIX_CACHE_PAGES = REGISTRY.gauge(
     "KV pages currently owned by the prefix-cache radix tree",
     labels=("model",))
 
+# -- latency attribution / SLO / alerting (telemetry/attribution.py,
+# telemetry/slo.py, engine/health.py watchdog) ------------------------------
+REQUEST_PHASE_MS = REGISTRY.histogram(
+    "ollamamq_request_phase_ms",
+    "Per-request latency attribution: milliseconds spent in each lifecycle "
+    "phase (queue/admission/prefix_cache/prefill/decode/stream), observed "
+    "at request finish; phases sum to end-to-end latency",
+    buckets=DEFAULT_LATENCY_BUCKETS_MS, labels=("model", "phase"))
+SLO_VIOLATIONS_TOTAL = REGISTRY.counter(
+    "ollamamq_slo_violations_total",
+    "Observations over the configured SLO threshold (--slo-ttft-ms / "
+    "--slo-tpot-ms), by objective; series exist only with SLOs configured",
+    labels=("objective",))
+SLO_BURN_RATE = REGISTRY.gauge(
+    "ollamamq_slo_burn_rate",
+    "Error-budget burn rate over each alerting window's long leg "
+    "(bad/total over window / (1 - target)); 1.0 = spending exactly the "
+    "budget, above the window's factor = alert", labels=("objective",
+                                                         "window"))
+SLO_ALERTS_FIRING = REGISTRY.gauge(
+    "ollamamq_slo_alerts_firing",
+    "Active alerts (SLO burn, watchdog stalls, device loss): 1 per "
+    "firing alert, rebuilt each scrape so resolved alerts disappear",
+    labels=("alert", "severity"))
+WATCHDOG_STALLS_TOTAL = REGISTRY.counter(
+    "ollamamq_watchdog_stalls_total",
+    "Stall watchdog firings by kind (engine_step, request_phase, "
+    "worker_host, device)", labels=("kind",))
+
 # -- host / device ---------------------------------------------------------
 HBM_USED_BYTES = REGISTRY.gauge(
     "ollamamq_hbm_used_bytes",

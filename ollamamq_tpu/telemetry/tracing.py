@@ -19,6 +19,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ollamamq_tpu.telemetry import attribution
 from ollamamq_tpu.telemetry import schema as tm
 
 # Per-trace event cap: a 100k-token generation must not grow its trace
@@ -30,7 +31,7 @@ DECODE_EVENT_EVERY = 16
 
 class Trace:
     __slots__ = ("req_id", "user", "model", "kind", "events", "dropped",
-                 "finished", "_tracer")
+                 "finished", "outcome", "_tracer")
 
     def __init__(self, tracer: "Tracer", req_id: int, user: str, model: str,
                  kind: str):
@@ -42,6 +43,7 @@ class Trace:
         self.events: List[tuple] = []  # (name, t_monotonic, args|None)
         self.dropped = 0
         self.finished = False
+        self.outcome: Optional[str] = None
 
     def event(self, name: str, _force: bool = False, **args) -> None:
         if self.finished:
@@ -58,6 +60,7 @@ class Trace:
             return
         self.event(outcome, _force=True)
         self.finished = True
+        self.outcome = outcome
         self._tracer._finished(self, outcome)
 
 
@@ -85,10 +88,26 @@ class Tracer:
             self._ring.append(tr)
         tm.REQUESTS_INFLIGHT.dec()
         tm.REQUESTS_TOTAL.labels(model=tr.model or "?", outcome=outcome).inc()
+        # Latency attribution: fold the finished timeline's per-phase
+        # totals into ollamamq_request_phase_ms.
+        attribution.observe_phases(tr.model, list(tr.events))
 
     def traces(self) -> List[Trace]:
         with self._lock:
             return list(self._ring) + list(self._live.values())
+
+    def find(self, req_id: int) -> Optional[Trace]:
+        """Latest trace for a request id: the in-flight table first, then
+        the finished ring newest-first (ids can recur across requeues —
+        the newest holder is the one an operator is asking about)."""
+        with self._lock:
+            for tr in self._live.values():
+                if tr.req_id == req_id:
+                    return tr
+            for tr in reversed(self._ring):
+                if tr.req_id == req_id:
+                    return tr
+        return None
 
     def export_chrome(self) -> dict:
         """Chrome trace-event JSON (the chrome://tracing 'JSON Array

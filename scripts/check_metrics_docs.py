@@ -1,10 +1,17 @@
 #!/usr/bin/env python3
-"""Doc/metric consistency gate: every metric the registry exports must be
-documented in README.md's Observability table, and every documented
-ollamamq_* name must still exist in the registry (no ghost docs).
+"""Doc/telemetry consistency gate, two surfaces:
 
-Imports ONLY ollamamq_tpu.telemetry.schema — the single declaration site
-for the metric surface — so the check runs without jax, a device, or an
+  1. metrics — every metric the registry exports must be documented in
+     README.md's Observability table, and every documented ollamamq_*
+     name must still exist in the registry (no ghost docs);
+  2. phases — every latency-attribution phase the engine can emit
+     (telemetry/attribution.py PHASES) must appear in the README phase
+     table (between the `<!-- phases:begin -->` / `<!-- phases:end -->`
+     markers), and the table must not document phases that no longer
+     exist.
+
+Imports ONLY ollamamq_tpu.telemetry.schema and .attribution — the
+declaration sites — so the check runs without jax, a device, or an
 engine. Wired into tier-1 via tests/test_metrics_docs.py.
 
 Usage: python scripts/check_metrics_docs.py [README.md]
@@ -18,6 +25,9 @@ import re
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PHASES_BEGIN = "<!-- phases:begin -->"
+PHASES_END = "<!-- phases:end -->"
 
 
 def documented_metric_names(readme_text: str) -> set:
@@ -35,6 +45,42 @@ def registered_metric_names() -> set:
     return set(REGISTRY.names())
 
 
+def documented_phase_names(readme_text: str) -> set:
+    """Backticked names inside the marked phase-table region. Markers
+    (not layout) scope the search, so `queue`-the-word elsewhere in the
+    README can't satisfy the check by accident."""
+    start = readme_text.find(PHASES_BEGIN)
+    end = readme_text.find(PHASES_END)
+    if start == -1 or end == -1 or end < start:
+        return set()
+    return set(re.findall(r"`([a-z_]+)`", readme_text[start:end]))
+
+
+def registered_phase_names() -> set:
+    sys.path.insert(0, _REPO)
+    from ollamamq_tpu.telemetry.attribution import PHASES
+
+    return set(PHASES)
+
+
+def _diff(readme: str, what: str, registered: set, documented: set,
+          missing_msg: str, ghost_msg: str) -> int:
+    rc = 0
+    missing = sorted(registered - documented)
+    ghosts = sorted(documented - registered)
+    if missing:
+        rc = 1
+        print(f"{readme}: {len(missing)} {missing_msg}:", file=sys.stderr)
+        for name in missing:
+            print(f"  - {name}", file=sys.stderr)
+    if ghosts:
+        rc = 1
+        print(f"{readme}: {len(ghosts)} {ghost_msg}:", file=sys.stderr)
+        for name in ghosts:
+            print(f"  - {name}", file=sys.stderr)
+    return rc
+
+
 def main(argv) -> int:
     readme = argv[1] if len(argv) > 1 else os.path.join(_REPO, "README.md")
     try:
@@ -43,25 +89,20 @@ def main(argv) -> int:
     except OSError as e:
         print(f"cannot read {readme}: {e}", file=sys.stderr)
         return 2
-    documented = documented_metric_names(text)
-    registered = registered_metric_names()
-    missing = sorted(registered - documented)
-    ghosts = sorted(documented - registered)
-    rc = 0
-    if missing:
-        rc = 1
-        print(f"{readme}: {len(missing)} registered metric(s) missing from "
-              "the README metric table:", file=sys.stderr)
-        for name in missing:
-            print(f"  - {name}", file=sys.stderr)
-    if ghosts:
-        rc = 1
-        print(f"{readme}: {len(ghosts)} documented metric(s) no longer "
-              "registered:", file=sys.stderr)
-        for name in ghosts:
-            print(f"  - {name}", file=sys.stderr)
+    rc = _diff(
+        readme, "metrics", registered_metric_names(),
+        documented_metric_names(text),
+        "registered metric(s) missing from the README metric table",
+        "documented metric(s) no longer registered")
+    rc |= _diff(
+        readme, "phases", registered_phase_names(),
+        documented_phase_names(text),
+        "attribution phase(s) missing from the README phase table "
+        f"(between {PHASES_BEGIN} / {PHASES_END})",
+        "documented phase(s) the attribution layer no longer emits")
     if rc == 0:
-        print(f"ok: {len(registered)} metrics, all documented")
+        print(f"ok: {len(registered_metric_names())} metrics and "
+              f"{len(registered_phase_names())} phases, all documented")
     return rc
 
 

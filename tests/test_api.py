@@ -50,7 +50,9 @@ def api_test(fn):
 async def test_health(client):
     r = await client.get("/health")
     assert r.status == 200
-    assert await r.text() == "OK"
+    body = await r.json()
+    assert body["status"] == "ok"
+    assert body["alerts"] == []
 
 
 @api_test

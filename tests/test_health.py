@@ -70,7 +70,8 @@ def test_device_probe_online_and_status():
         assert hm.last_device_check > 0.0
         assert hm.device_online  # CPU backend answers the probe
         st = hm.status()
-        assert set(st) == {"device_online", "engine_stalled",
-                           "last_device_check"}
+        assert set(st) == {"status", "device_online", "engine_stalled",
+                           "last_device_check", "alerts"}
+        assert st["status"] == "ok" and st["alerts"] == []
     finally:
         hm.stop()

@@ -34,3 +34,27 @@ def test_checker_catches_missing_and_ghost_names(tmp_path):
     ghost = tmp_path / "README_ghost.md"
     ghost.write_text(full + "\n| `ollamamq_definitely_not_real` | gauge |\n")
     assert mod.main(["check_metrics_docs.py", str(ghost)]) == 1
+
+
+def test_checker_pins_attribution_phase_table(tmp_path):
+    """Satellite: every phase the attribution layer can emit must appear
+    in the README phase table (marker-scoped), and the table must not
+    document phases that no longer exist."""
+    mod = _load()
+    with open(os.path.join(_REPO, "README.md"), encoding="utf-8") as f:
+        full = f.read()
+    # A documented phase row removed => missing-phase failure.
+    assert "| `queue` |" in full, "phase table row shape changed"
+    missing = tmp_path / "README_nophase.md"
+    missing.write_text(full.replace("| `queue` |", "| queue-less |", 1))
+    assert mod.main(["check_metrics_docs.py", str(missing)]) == 1
+    # A ghost phase inside the markers => ghost-phase failure.
+    ghost = tmp_path / "README_ghostphase.md"
+    ghost.write_text(full.replace(
+        mod.PHASES_END, "| `notarealphase` | bogus |\n" + mod.PHASES_END, 1))
+    assert mod.main(["check_metrics_docs.py", str(ghost)]) == 1
+    # Markers stripped entirely => every phase reads as undocumented.
+    bare = tmp_path / "README_nomarkers.md"
+    bare.write_text(full.replace(mod.PHASES_BEGIN, "").replace(
+        mod.PHASES_END, ""))
+    assert mod.main(["check_metrics_docs.py", str(bare)]) == 1

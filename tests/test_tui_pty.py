@@ -60,10 +60,10 @@ print("TUI_EXIT_OK", flush=True)
 
 
 class _PtyTui:
-    def __init__(self, tmp_path):
+    def __init__(self, tmp_path, child_src=_CHILD):
         self.blockfile = str(tmp_path / "blocked_items.json")
         child = tmp_path / "tui_child.py"
-        child.write_text(_CHILD)
+        child.write_text(child_src)
         self.master, slave = pty.openpty()
         # A real terminal size so the 3-column layout renders.
         fcntl.ioctl(self.master, termios.TIOCSWINSZ,
@@ -194,3 +194,50 @@ def _stderr(t):
         return t.errfile.read_text(errors="replace")[-2000:]
     except Exception:
         return "<no stderr>"
+
+
+# Same harness, but the engine stub carries a live AlertManager with a
+# firing SLO alert — the ALERTS panel must render it.
+_CHILD_ALERTS = _CHILD.replace(
+    'eng.runtimes = {}\nadmin_tui.run_tui(eng, None, refresh_ms=50)',
+    '''eng.runtimes = {}
+from ollamamq_tpu.telemetry.slo import AlertManager
+eng.alerts = AlertManager()
+eng.alerts.fire("slo_ttft_burn_fast", "page",
+                "ttft SLO burning 20.0x budget over 300s", source="slo")
+admin_tui.run_tui(eng, None, refresh_ms=50)''')
+assert _CHILD_ALERTS != _CHILD, "alerts child patch failed to apply"
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="pty/termios test")
+def test_tui_alerts_panel_via_pty(tmp_path):
+    """ISSUE 3 acceptance: a firing alert shows in the TUI alerts panel
+    (rendered frames through a real pty, not the brief dict alone)."""
+    t = _PtyTui(tmp_path, child_src=_CHILD_ALERTS)
+    try:
+        assert t.wait_output(b"ALERTS (1 firing)"), _stderr(t)
+        assert t.wait_output(b"slo_ttft_burn_fast"), _stderr(t)
+        assert t.wait_output(b"[page]")
+        assert t.wait_output("⚠".encode())
+        # Resolve -> the panel goes quiet ("(none)") on a later frame.
+        # (The alert table is in the child process; quit instead.)
+        t.clear()
+        t.send("q")
+        assert t.wait_output(b"TUI_EXIT_OK"), _stderr(t)
+        assert t.proc.wait(timeout=30) == 0
+    finally:
+        t.close()
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="pty/termios test")
+def test_tui_no_alerts_renders_quiet_panel(tmp_path):
+    """Without an alert table (or with it empty) the ALERTS section still
+    renders, showing (none) — layout must not depend on alert state."""
+    t = _PtyTui(tmp_path)
+    try:
+        assert t.wait_output(b"ALERTS"), _stderr(t)
+        assert t.wait_output(b"(none)"), _stderr(t)
+        t.send("q")
+        assert t.wait_output(b"TUI_EXIT_OK"), _stderr(t)
+    finally:
+        t.close()
