@@ -48,6 +48,7 @@ def _fallback_argv(model: str) -> list:
             "--shared-prefix", "2", "--shared-prefix-len", "64",
             "--shared-prefix-tail", "16",
             "--slo-burst", "2", "--slo-burst-size", "4",
+            "--overload", "16",
             "--init-timeout", "300"]
 
 
@@ -122,6 +123,15 @@ def main() -> int:
                    help="requests arriving at once per burst")
     p.add_argument("--slo-ttft-ms", type=float, default=250.0,
                    help="TTFT objective for the slo_burst scenario (ms)")
+    p.add_argument("--overload", type=int, default=24,
+                   help="requests in the overload scenario (arrival rate "
+                        "> capacity over a bounded queue, with fault "
+                        "injection driving KV-pressure preemption and a "
+                        "prefill fault; reports shed rate, preemptions, "
+                        "recompute overhead, p99 TTFT); 0 disables")
+    p.add_argument("--overload-queue-cap", type=int, default=0,
+                   help="queued-request cap for the overload scenario "
+                        "(0 = 2x slots)")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU platform (smoke-testing the harness)")
     p.add_argument("--init-timeout", type=float, default=300.0,
@@ -514,6 +524,23 @@ def main() -> int:
         finally:
             rt.prefix_cache = None  # detach: rt state stays cache-free
 
+    # overload scenario: arrival rate > capacity over a bounded queue,
+    # with deterministic fault injection supplying KV-pressure (preempt +
+    # recompute) and one contained prefill fault — the chaos acceptance
+    # run: zero crashes, zero silent truncations, every request either
+    # completes or terminates with an explicit shed/deadline/error.
+    overload = None
+    if args.overload > 0:
+        try:
+            overload = _overload_scenario(rt, core, args, rng, touch)
+        except Exception as e:  # never discard the decode numbers
+            overload = {"error": f"{type(e).__name__}: {e}"}
+            print(f"# overload scenario failed: {overload['error']}",
+                  file=sys.stderr)
+        finally:
+            rt.fault_plan = None
+            rt.on_preempt = None
+
     # slo_burst scenario: bursty arrivals against a TTFT objective —
     # where does the burst's latency actually go (queue vs prefill), and
     # how fast does it burn the error budget? Anchors the SLO/attribution
@@ -566,9 +593,172 @@ def main() -> int:
         result["shared_prefix"] = shared_prefix
     if slo_burst is not None:
         result["slo_burst"] = slo_burst
+    if overload is not None:
+        result["overload"] = overload
     run_done.set()
     print(json.dumps(result), flush=True)
     return 0
+
+
+def _overload_scenario(rt, core, args, rng, touch):
+    """Graceful-degradation acceptance: N requests arrive faster than the
+    engine drains them, over a bounded queue, with a seeded fault plan
+    supplying KV-allocation pressure (every few decode-time page growths
+    fail => preemption with recompute) and one injected prefill fault
+    (=> contained retry). Reports shed rate, preemption count, recompute
+    token overhead, deadline drops, p99 TTFT — and `silent_truncations`,
+    which the chaos acceptance criterion requires to be ZERO: every
+    request either completes or carries an explicit shed/deadline/error
+    reason."""
+    import statistics
+    import time
+
+    from ollamamq_tpu.engine.engine import drop_expired
+    from ollamamq_tpu.engine.request import FinishReason, Request
+    from ollamamq_tpu.ops.sampling import SamplingParams
+    from ollamamq_tpu.telemetry import schema as tm
+    from ollamamq_tpu.testing.faults import FaultPlan
+
+    n_total = args.overload
+    qcap = args.overload_queue_cap or max(2, 2 * args.slots)
+    max_ctx = rt.ecfg.max_pages_per_seq * rt.ecfg.page_size
+    prompt_len = min(args.prompt_len, 64)
+    max_new = 16
+    hi = min(rt.cfg.vocab_size, 30000)
+
+    def drain():
+        for s, r in enumerate(rt.slot_req):
+            if r is not None:
+                rt._finish_slot(s, FinishReason.CANCELLED, core)
+
+    drain()
+    plan = FaultPlan([
+        # KV pressure: every 5th decode-time page growth "fails",
+        # driving the preempt-with-recompute path repeatedly.
+        {"site": "extend", "kind": "alloc_fail", "every": 5},
+        # One transient prefill fault: its batch must retry and survive.
+        {"site": "prefill", "kind": "exception", "at": [4]},
+    ], seed=7)
+    rt.fault_plan = plan
+
+    recompute = {"tokens": 0}
+    preempt0, retries0 = rt.preempt_count, rt.retry_count
+
+    def requeue(req):
+        # The engine's on_preempt hook, bench-local: front of the queue,
+        # deadline honored, recompute overhead tallied.
+        if req.expired():
+            drop_expired(req, core, rt.name)
+            return False
+        recompute["tokens"] += len(req.prompt_tokens)
+        rt.pending_prefill.appendleft(req)
+        return True
+
+    rt.on_preempt = requeue
+
+    def shed_count():
+        return sum(c.value for _, c in tm.SHED_TOTAL.series())
+
+    def deadline_count():
+        return sum(c.value for _, c in tm.DEADLINE_DROPS_TOTAL.series())
+
+    shed0, dl0 = shed_count(), deadline_count()
+    reqs, shed_at_admission, issued = [], 0, 0
+    t_start = time.monotonic()
+    guard = 0
+    while True:
+        # Arrivals: a burst of 4 per engine tick — strictly faster than
+        # the batch drains, so the bounded queue must shed.
+        burst = 0
+        while issued < n_total and burst < 4:
+            burst += 1
+            if len(rt.pending_prefill) + len(rt.chunking) >= qcap:
+                # Bounded admission (the server's 503/429 path): count
+                # the shed, never construct engine-side state for it.
+                tm.SHED_TOTAL.labels(reason="queue_full").inc()
+                shed_at_admission += 1
+                issued += 1
+                continue
+            prompt = rng.integers(3, hi, size=prompt_len).tolist()
+            sp = SamplingParams(max_tokens=max_new)
+            if issued % 5 == 4:
+                # Every 5th request carries a tight deadline; under the
+                # backlog some expire in queue and must drop BEFORE
+                # prefill, with the explicit deadline reason.
+                sp = SamplingParams(max_tokens=max_new, deadline_ms=30.0)
+            req = Request(40000 + issued, f"ovl{issued % 8}", rt.name,
+                          prompt, sp)
+            req._inc_decode = rt.tokenizer.make_incremental_decoder()
+            reqs.append(req)
+            rt.pending_prefill.append(req)
+            issued += 1
+        # One engine tick: admission + chunk + decode.
+        progressed = False
+        try:
+            progressed = rt.step_prefill(core)
+            progressed = rt.step_chunk(core) or progressed
+            if any(r is not None for r in rt.slot_req):
+                progressed = (rt.step_decode(core, k_steps=2) > 0) \
+                    or progressed
+        except Exception as e:
+            # The acceptance criterion is ZERO engine crashes: any
+            # escape from the contained paths fails the scenario.
+            raise RuntimeError(f"engine step escaped containment: "
+                               f"{type(e).__name__}: {e}")
+        touch("overload")
+        unresolved = [r for r in reqs if not r.stats.finished_at]
+        if issued >= n_total and not unresolved:
+            break
+        guard += 1
+        if guard > 2000 * n_total:
+            raise RuntimeError(
+                f"overload scenario wedged: {len(unresolved)} unresolved")
+        if not progressed:
+            if not unresolved:
+                break
+            time.sleep(0.001)  # head-of-queue backoff: don't spin hot
+    elapsed_s = time.monotonic() - t_start
+
+    outcomes: dict = {}
+    silent_truncations = 0
+    ttfts = []
+    for r in reqs:
+        item = None
+        for it in r.stream.drain():
+            if it.kind in ("done", "error"):
+                item = it
+        reason = (item.finish_reason.value
+                  if item is not None and item.finish_reason else "none")
+        outcomes[reason] = outcomes.get(reason, 0) + 1
+        if r.stats.first_token_at:
+            ttfts.append(r.stats.ttft_ms)
+        if (item is not None and item.finish_reason == FinishReason.LENGTH
+                and len(r.generated_ids) < r.sampling.max_tokens
+                and len(r.prompt_tokens) + len(r.generated_ids) + 1 < max_ctx):
+            silent_truncations += 1  # MUST stay 0: the bug this PR kills
+
+    ttfts.sort()
+    served = len(ttfts)
+    return {
+        "requests": n_total,
+        "queue_cap": qcap,
+        "elapsed_s": round(elapsed_s, 3),
+        "shed": int(shed_count() - shed0),
+        "shed_at_admission": shed_at_admission,
+        "shed_rate": round((shed_count() - shed0) / max(1, n_total), 4),
+        "deadline_drops": int(deadline_count() - dl0),
+        "preemptions": rt.preempt_count - preempt0,
+        "retries": rt.retry_count - retries0,
+        "recompute_tokens": recompute["tokens"],
+        "injected_faults": plan.injected,
+        "outcomes": outcomes,
+        "served": served,
+        "ttft_p50_ms": round(ttfts[served // 2], 1) if served else None,
+        "ttft_p99_ms": (round(ttfts[min(served - 1,
+                                        int(0.99 * served))], 1)
+                        if served else None),
+        "silent_truncations": silent_truncations,
+    }
 
 
 def _slo_burst_scenario(rt, core, args, rng, touch):

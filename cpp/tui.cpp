@@ -346,12 +346,22 @@ struct Tui {
       std::snprintf(cache, sizeof cache, "cache %.0f%%",
                     cache_lookups > 0 ? 100.0 * cache_hits / cache_lookups
                                       : 0.0);
+    /* Degradation chip: requests shed (admission caps / deadlines / KV
+     * exhaustion) and KV-pressure preemptions. Both nonzero is the
+     * "saturated but degrading gracefully" signature; shed rising with
+     * preempt flat means the queue caps are doing the shedding. */
+    double shed = stats->get("shed") ? stats->get("shed")->as_num() : 0;
+    double preempt =
+        stats->get("preempt") ? stats->get("preempt")->as_num() : 0;
+    char degrade[48];
+    std::snprintf(degrade, sizeof degrade, "shed %.0f  preempt %.0f", shed,
+                  preempt);
     if (mfu > 0)
-      std::snprintf(l, sizeof l, " throughput %.0f tok/s   MFU %.2f%%   %s",
-                    tok_rate > 0 ? tok_rate : 0.0, mfu * 100.0, cache);
+      std::snprintf(l, sizeof l, " throughput %.0f tok/s   MFU %.2f%%   %s   %s",
+                    tok_rate > 0 ? tok_rate : 0.0, mfu * 100.0, cache, degrade);
     else
-      std::snprintf(l, sizeof l, " throughput %.0f tok/s   MFU --   %s",
-                    tok_rate > 0 ? tok_rate : 0.0, cache);
+      std::snprintf(l, sizeof l, " throughput %.0f tok/s   MFU --   %s   %s",
+                    tok_rate > 0 ? tok_rate : 0.0, cache, degrade);
     out.push_back(std::string(CYAN) + l + RST);
     /* One row PER chip (pod-wide under SPMD): the north star's "per-chip
      * HBM occupancy" — a v5e-16 must not show chip 0 for the pod. */

@@ -68,12 +68,19 @@ def _engine_stats_brief(engine) -> dict:
                       for a in am.active()]
         except Exception:
             alerts = []
+    # Degradation chip: total sheds (admission caps / deadlines / kv
+    # exhaustion, engine-side mirror of ollamamq_shed_total) and total
+    # KV-pressure preemptions across runtimes.
+    shed = sum(getattr(engine, "shed_counts", {}).values())
+    preempt = sum(m.get("preemptions", 0) or 0 for m in models)
     return {
         "models": models,
         "device": _hbm_cache["device"] or "no-device",
         "chips": _hbm_cache["chips"],
         "hbm_used": _hbm_cache["used"],
         "hbm_total": _hbm_cache["total"],
+        "shed": shed,
+        "preempt": preempt,
         "alerts": alerts,
     }
 

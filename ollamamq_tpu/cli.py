@@ -73,6 +73,29 @@ def build_parser() -> argparse.ArgumentParser:
                    help="GPipe microbatches per pp dispatch (0 = one per "
                         "stage; sweep on hardware — prefill wants more, "
                         "weight-bound decode may want fewer)")
+    # Graceful degradation under load.
+    p.add_argument("--max-queued", type=int, default=0,
+                   help="global queued-request cap: past it, enqueues are "
+                        "shed with 503 + Retry-After (derived from the "
+                        "observed completion rate); 0 = unbounded")
+    p.add_argument("--max-queued-per-user", type=int, default=0,
+                   help="per-user queued-request cap: past it, that "
+                        "user's enqueues are shed with 429 + Retry-After; "
+                        "0 = unbounded")
+    p.add_argument("--no-preempt", action="store_true",
+                   help="disable preemption-with-recompute: decode-time "
+                        "KV-pool exhaustion then errors the request "
+                        "explicitly (done_reason kv_exhausted) instead "
+                        "of preempting a victim for later recompute")
+    p.add_argument("--preempt-max", type=int, default=3,
+                   help="anti-livelock budget: preemptions allowed per "
+                        "request before it holds its reservation and is "
+                        "never picked as a victim again")
+    p.add_argument("--fault-plan", default="",
+                   help="deterministic fault-injection plan (JSON; see "
+                        "ollamamq_tpu/testing/faults.py) wired into the "
+                        "dispatch/allocation seams — chaos benching; "
+                        "malformed plans fail startup loudly")
     # SLOs + alerting.
     p.add_argument("--slo-ttft-ms", type=float, default=0.0,
                    help="TTFT latency objective in ms (enqueue to first "
@@ -164,6 +187,21 @@ def main(argv=None) -> int:
     if not (0.0 < args.slo_target < 1.0):
         log.error("--slo-target must be in (0, 1), got %s", args.slo_target)
         return 2
+    if args.max_queued < 0 or args.max_queued_per_user < 0 \
+            or args.preempt_max < 0:
+        log.error("--max-queued / --max-queued-per-user / --preempt-max "
+                  "must be >= 0")
+        return 2
+    if args.fault_plan:
+        # Schema-check the plan BEFORE any engine/device work: a typo'd
+        # chaos plan must fail the process at startup, not mid-traffic.
+        from ollamamq_tpu.testing.faults import FaultPlan, FaultPlanError
+
+        try:
+            FaultPlan.load(args.fault_plan)
+        except FaultPlanError as e:
+            log.error("invalid --fault-plan: %s", e)
+            return 2
 
     if args.cpu:
         from ollamamq_tpu.parallel.distributed import multiprocess_configured
@@ -227,6 +265,11 @@ def main(argv=None) -> int:
         slo_ttft_ms=args.slo_ttft_ms or None,
         slo_tpot_ms=args.slo_tpot_ms or None,
         slo_target=args.slo_target,
+        preempt=not args.no_preempt,
+        preempt_max=args.preempt_max,
+        max_queued=args.max_queued,
+        max_queued_per_user=args.max_queued_per_user,
+        fault_plan=args.fault_plan or None,
     )
     fairness = Fairness.TOKENS if args.token_fairness else Fairness.REQUESTS
 

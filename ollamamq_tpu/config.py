@@ -255,6 +255,29 @@ class EngineConfig:
     slo_ttft_ms: Optional[float] = None
     slo_tpot_ms: Optional[float] = None
     slo_target: float = 0.99
+    # -- graceful degradation under load ------------------------------------
+    # Preemption with recompute: decode-time KV-pool exhaustion preempts a
+    # victim (never the VIP) back to the FRONT of its user's queue instead
+    # of truncating; re-admission prefills prompt+generated through the
+    # normal path (mostly cache hits with --prefix-cache). Off => explicit
+    # kv_exhausted error, NEVER a silent LENGTH.
+    preempt: bool = True
+    # Anti-livelock budget: after this many preemptions a request holds
+    # its reservation (slot + pages) and is never picked as a victim.
+    preempt_max: int = 3
+    # Bounded admission: total / per-user queued-request caps (0 = off).
+    # Over-cap enqueues are shed with 503 / 429 + Retry-After instead of
+    # growing the queue unboundedly.
+    max_queued: int = 0
+    max_queued_per_user: int = 0
+    # Failure containment: requests implicated in a failed runtime step
+    # are retried this many times (fresh dispatch, exponential backoff
+    # from retry_backoff_s) before being poisoned with an explicit error.
+    step_retries: int = 1
+    retry_backoff_s: float = 0.2
+    # Deterministic fault injection (testing/faults.py): path to a plan
+    # file, or a FaultPlan instance (tests). None = no injection.
+    fault_plan: Optional[object] = None
 
     @property
     def max_context(self) -> int:

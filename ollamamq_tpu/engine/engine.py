@@ -77,6 +77,17 @@ def sweep_blocked(core: MQCore, held_fn, last_version: int) -> int:
     return ver
 
 
+def drop_expired(req: Request, core: MQCore, model: str) -> None:
+    """Finish an expired request with the explicit deadline reason and
+    count the shed — expired queued work is dropped without burning a
+    single TPU cycle on it, and the client learns WHY."""
+    core.mark_dropped(req.user, started=getattr(req, "started", True))
+    tm.DEADLINE_DROPS_TOTAL.labels(model=model or "?").inc()
+    tm.SHED_TOTAL.labels(reason="deadline").inc()
+    req.finish(FinishReason.DEADLINE,
+               error="deadline expired before completion")
+
+
 def per_chip_stats() -> List[dict]:
     """One row per LOCAL device: id, kind, HBM in use / limit. The TUI
     chips panel and /metrics render these per chip (a v5e-16 must not
@@ -106,6 +117,22 @@ def per_chip_stats() -> List[dict]:
     return out
 
 
+class QueueFullError(Exception):
+    """Bounded admission refused an enqueue: the queue (global or this
+    user's) is at its --max-queued / --max-queued-per-user cap. Carries
+    the Retry-After estimate (seconds) derived from the observed
+    completion rate, so the HTTP layer can answer 503/429 honestly
+    instead of growing the queue unboundedly."""
+
+    def __init__(self, scope: str, retry_after_s: float, limit: int):
+        self.scope = scope  # "queue_full" | "user_queue_full"
+        self.retry_after_s = retry_after_s
+        self.limit = limit
+        super().__init__(
+            f"{scope.replace('_', ' ')}: admission cap {limit} reached; "
+            f"retry after ~{retry_after_s:.0f}s")
+
+
 class WorkerDesyncError(RuntimeError):
     """An SPMD status sync reported a worker-host replay failure: device
     state diverged across hosts. Unlike a local batch failure this must
@@ -132,10 +159,16 @@ def serve_embed_batch(rt, core: "MQCore", pending, max_len: int,
     (it is in no queue _fail_runtime can see)."""
     batch: List[Request] = []
     while pending and len(batch) < max_batch:
+        if pending[0]._retry_at > time.monotonic():
+            break  # head is backing off after a contained fault
         req = pending.popleft()
         if req.cancelled.is_set():
             core.mark_dropped(req.user)
             req.finish(FinishReason.CANCELLED)
+            continue
+        if req.expired():
+            # Expired queued embeds are dropped before the batch forward.
+            drop_expired(req, core, rt.name)
             continue
         n = len(req.prompt_tokens)
         if n > max_len:
@@ -167,9 +200,21 @@ def serve_embed_batch(rt, core: "MQCore", pending, max_len: int,
     try:
         out = np.asarray(dispatch(B, bucket, tokens, lens))
     except Exception as e:
+        # Retry-or-poison each implicated request where the runtime
+        # offers the seam (generative ModelRuntime keeps serving after an
+        # embed failure); encoders error the batch as before — the
+        # exception still propagates so the caller decides runtime fate.
+        retry = getattr(rt, "_retry_embed", None)
+        desync = isinstance(e, WorkerDesyncError)
         for r in batch:
+            if not desync and retry is not None \
+                    and retry(r, f"embed failed: {e}"):
+                continue
             core.mark_dropped(r.user)
-            r.finish(FinishReason.ERROR, error=f"embed failed: {e}")
+            poison = getattr(rt, "_poison_msg", None)
+            msg = f"embed failed: {e}"
+            r.finish(FinishReason.ERROR,
+                     error=poison(r, msg) if poison else msg)
         raise
     rt.step_latency_ms = (time.monotonic() - t0) * 1e3
     for i, r in enumerate(batch):
@@ -195,6 +240,19 @@ class ModelRuntime:
     # owning engine's load_model/_swap_rebuilt. None on SPMD worker
     # hosts' replay runtimes — SLO accounting is primary-only.
     slo = None
+
+    # Preemption hook, attached by the owning engine (load_model /
+    # _swap_rebuilt) when cfg.preempt is on: callable(req) -> bool that
+    # returns the victim to the FRONT of its user's native queue and
+    # re-registers it (False = the hook finished the request instead —
+    # blocked/cancelled/expired). None => preemption disabled: decode
+    # page exhaustion errors EXPLICITLY (kv_exhausted), never truncates.
+    on_preempt = None
+
+    # Deterministic fault injection (testing/faults.py), attached by the
+    # engine when --fault-plan is set. Shared across a process's runtimes
+    # so the plan's call counters form one deterministic stream.
+    fault_plan = None
 
     def __init__(
         self,
@@ -308,6 +366,12 @@ class ModelRuntime:
         # Slots mid-chunked-prefill: reserved (not schedulable) but not yet
         # decoding — slot_req stays None so decode skips them.
         self.reserved_slots: set = set()
+        # Slots holding a page reservation: their request exhausted its
+        # preemption budget (or no victim was eligible) when the pool ran
+        # dry, so it KEEPS slot + pages but sits out decode dispatches
+        # until growth succeeds — never truncated, never a victim spiral.
+        self._stalled_slots: set = set()
+        self._stall_since: Optional[float] = None
         self.slot_req: List[Optional[Request]] = [None] * S
         self.slot_pages: List[List[int]] = [[] for _ in range(S)]
         # Pinned prefix-cache nodes per slot (always a PREFIX of
@@ -376,6 +440,8 @@ class ModelRuntime:
         self.step_latency_ms = 0.0
         self.prefill_latency_ms = 0.0
         self.tokens_generated = 0
+        self.preempt_count = 0
+        self.retry_count = 0
         self.ttft_window: collections.deque = collections.deque(maxlen=512)
         self.step_window: collections.deque = collections.deque(maxlen=512)
         # Registry handles resolved once (child lookup is a dict hit, but
@@ -390,6 +456,8 @@ class ModelRuntime:
         self._tm_mfu = tm.MFU.labels(model=name)
         self._tm_tokens = tm.TOKENS_GENERATED_TOTAL.labels(model=name)
         self._tm_prompt_tokens = tm.PROMPT_TOKENS_TOTAL.labels(model=name)
+        self._tm_preempt = tm.PREEMPTIONS_TOTAL.labels(model=name)
+        self._tm_retries = tm.RETRIES_TOTAL.labels(model=name)
         # MFU accounting: analytic FLOPs/token (models/llama config) over
         # this runtime's share of chip peak. Unknown accelerators (CPU
         # meshes) publish 0, never a made-up peak.
@@ -461,7 +529,10 @@ class ModelRuntime:
         if req.kind == "embed":
             self.pending_embed.append(req)
             return True
-        req._inc_decode = self.tokenizer.make_incremental_decoder()
+        if getattr(req, "_inc_decode", None) is None:
+            # Preserved across preemption/retry requeues: the replay
+            # prompt carries already-generated ids the decoder has seen.
+            req._inc_decode = self.tokenizer.make_incremental_decoder()
         self.pending_prefill.append(req)
         return True
 
@@ -476,11 +547,21 @@ class ModelRuntime:
         self._rng_counter += 1
         return jax.random.PRNGKey(self._rng_counter)
 
+    def _fault(self, site: str) -> None:
+        """Fault-injection seam, called at the top of every dispatch: a
+        firing rule raises (exception/device_loss) or sleeps (slow)
+        BEFORE the jit call, so donated buffers are never consumed by an
+        injected failure — exactly the recoverable-fault shape the
+        retry/containment paths exist for."""
+        if self.fault_plan is not None:
+            self.fault_plan.check(site)
+
     # -- dispatch seams (SPMD subclass broadcasts before dispatching) ------
     # Each returns (sampled_tokens, kc', vc', recent'); the caller assigns
     # the three state arrays back.
     def _dispatch_prefill(self, bucket, B, tokens, lens, slot_ids, pt_rows,
                           temp, tk, tp, pen, pres, freq, seeds, key):
+        self._fault("prefill")
         fn = self._get_prefill_jit(
             bucket, B, sampling_flags(temp, tk, tp, pen, pres, freq)
         )
@@ -493,6 +574,7 @@ class ModelRuntime:
     def _dispatch_chunk(self, chunk, tokens, start, cl, slot_id, is_final,
                         is_first, seed_row, pt_row, temp, tk, tp, pen, pres,
                         freq, seeds, key):
+        self._fault("chunk")
         fn = self._get_chunk_jit(
             chunk, sampling_flags(temp, tk, tp, pen, pres, freq)
         )
@@ -526,6 +608,7 @@ class ModelRuntime:
 
     def _dispatch_decode(self, k_steps, tokens, positions, active, pt, temp,
                          tk, tp, pen, pres, freq, seeds, key):
+        self._fault("decode")
         fn = self._get_decode_jit(
             k_steps, sampling_flags(temp, tk, tp, pen, pres, freq)
         )
@@ -632,6 +715,7 @@ class ModelRuntime:
 
     def _dispatch_prefill_sp(self, T, tokens, lens, slot_ids, pt_rows,
                              temp, tk, tp, pen, pres, freq, seeds, key):
+        self._fault("sp_prefill")
         fn = self._get_sp_prefill_jit(
             T, sampling_flags(temp, tk, tp, pen, pres, freq)
         )
@@ -717,14 +801,18 @@ class ModelRuntime:
         except Exception as e:
             # Contain the failure to THIS request (the batched path does the
             # same): release the never-installed slot's pages — _fail_runtime
-            # would miss them since slot_req[slot] is still None — and keep
-            # every other in-flight request alive.
+            # would miss them since slot_req[slot] is still None — retry it
+            # once, and keep every other in-flight request alive.
             log.exception("sequence-parallel prefill failed for req %d",
                           req.req_id, extra={"req_id": req.req_id})
             self._release_slot_pages(slot)
-            core.mark_dropped(req.user)
-            req.finish(FinishReason.ERROR, error=f"sp prefill failed: {e}")
-            if isinstance(e, WorkerDesyncError):
+            desync = isinstance(e, WorkerDesyncError)
+            if desync or not self._retry_requeue(
+                    req, self.pending_prefill, f"sp prefill failed: {e}"):
+                core.mark_dropped(req.user)
+                req.finish(FinishReason.ERROR, error=self._poison_msg(
+                    req, f"sp prefill failed: {e}"))
+            if desync:
                 raise  # diverged SPMD state: the runtime must kill+reload
             return
         finally:
@@ -794,17 +882,9 @@ class ModelRuntime:
         return self._decode_jits[key_]
 
     # -- slot lifecycle ----------------------------------------------------
-    def _finish_slot(
-        self, slot: int, reason: FinishReason, core: MQCore, flush: bool = True
-    ) -> None:
-        """`flush=False` on the stop-string path: held-back text contains the
-        stop sequence the client asked to suppress."""
-        req = self.slot_req[slot]
-        if req is None:
-            return
-        # Pass req: an installed slot's prompt KV is fully written, so
-        # its full prompt pages are insertable into the prefix cache.
-        self._release_slot_pages(slot, req)
+    def _clear_slot(self, slot: int) -> None:
+        """Reset a slot's sampling rows and bookkeeping (pages must be
+        released by the caller — finish and preempt release differently)."""
         self.seq_lens[slot] = 0
         self.temp[slot] = 0.0
         self.top_k[slot] = 0
@@ -814,8 +894,31 @@ class ModelRuntime:
         self.freq_pen[slot] = 0.0
         self.seeds[slot] = 0
         self.slot_req[slot] = None
+        self._stalled_slots.discard(slot)
+
+    def _finish_slot(
+        self, slot: int, reason: FinishReason, core: MQCore,
+        flush: bool = True, error: str = "",
+    ) -> None:
+        """`flush=False` on the stop-string path: held-back text contains the
+        stop sequence the client asked to suppress."""
+        req = self.slot_req[slot]
+        if req is None:
+            return
+        # Pass req: an installed slot's prompt KV is fully written, so
+        # its full prompt pages are insertable into the prefix cache.
+        self._release_slot_pages(slot, req)
+        self._clear_slot(slot)
         req.stats.completion_tokens = len(req.generated_ids)
         if reason == FinishReason.CANCELLED:
+            core.mark_dropped(req.user)
+        elif reason in (FinishReason.KV_EXHAUSTED, FinishReason.ERROR):
+            # Honest failure: the client keeps the text generated so far
+            # (flushed) but the request counts dropped, not processed.
+            if flush:
+                chunk = req.flush_text()
+                if chunk:
+                    req.stream.push(StreamItem("token", text=chunk))
             core.mark_dropped(req.user)
         else:
             if flush:
@@ -823,7 +926,7 @@ class ModelRuntime:
                 if chunk:
                     req.stream.push(StreamItem("token", text=chunk))
             core.mark_done(req.user, tokens=len(req.generated_ids))
-        req.finish(reason)
+        req.finish(reason, error=error)
 
     def _emit_token(self, slot: int, tok: int, core: MQCore) -> bool:
         """Process one sampled token for a slot. Returns True if seq continues."""
@@ -892,6 +995,14 @@ class ModelRuntime:
                 self.pending_prefill.popleft()
                 core.mark_dropped(req.user)
                 req.finish(FinishReason.CANCELLED)
+                continue
+            if req._retry_at > time.monotonic():
+                break  # head is backing off after a contained fault
+            if req.expired():
+                # Deadline check BEFORE the prefill dispatch: expired
+                # queued work is dropped without burning TPU time.
+                self.pending_prefill.popleft()
+                drop_expired(req, core, self.name)
                 continue
             n = len(req.prompt_tokens)
             # Prompts beyond the largest bucket stream through chunked
@@ -975,8 +1086,11 @@ class ModelRuntime:
                     pages, self.ecfg.max_pages_per_seq
                 )[None, :]
                 # Incremental chunked prefill: ONE chunk per engine tick so
-                # concurrent decode streams keep flowing.
+                # concurrent decode streams keep flowing. _chunk_base reset
+                # explicitly: a retry/preemption re-admission may have left
+                # a cache-hit base from its previous life.
                 req._chunk_pos = 0
+                req._chunk_base = 0
                 req._prefill_slot = slot
                 self.reserved_slots.add(slot)
                 self.chunking.append(req)
@@ -1048,15 +1162,21 @@ class ModelRuntime:
             )
             toks = np.asarray(toks)
         except Exception as e:
-            # Fail ONLY this batch: free its pages, error its requests —
-            # never leave a client hanging or a page leaked.
+            # Contain the failure to THIS batch: free its pages, then give
+            # each implicated request one retried dispatch (with backoff)
+            # before poisoning it — one bad input or transient device
+            # fault must neither kill bystanders nor crash-loop.
+            desync = isinstance(e, WorkerDesyncError)
             for req, slot, pages, _ in batch:
                 self._release_slot_pages(slot)
-                core.mark_dropped(req.user)
-                req.finish(FinishReason.ERROR, error=f"prefill failed: {e}")
+                if desync or not self._retry_requeue(
+                        req, self.pending_prefill, f"prefill failed: {e}"):
+                    core.mark_dropped(req.user)
+                    req.finish(FinishReason.ERROR, error=self._poison_msg(
+                        req, f"prefill failed: {e}"))
             self.inflight_prefill = []
             log.exception("batched prefill failed (bucket=%d B=%d)", bucket, B)
-            if isinstance(e, WorkerDesyncError):
+            if desync:
                 raise  # diverged SPMD state: the runtime must kill+reload
             return True
         finally:
@@ -1091,6 +1211,8 @@ class ModelRuntime:
         """alloc() with the prefix-cache eviction backstop: free-list
         exhaustion reclaims unreferenced cached pages (LRU sweep) instead
         of failing admission."""
+        if self.fault_plan is not None and self.fault_plan.blocked("alloc"):
+            return None  # injected allocation pressure
         pages = self.alloc.alloc(num_tokens)
         if pages is None and self.prefix_cache is not None:
             short = self.alloc.pages_needed(num_tokens) - self.alloc.free_pages
@@ -1111,6 +1233,8 @@ class ModelRuntime:
 
     def _extend_pages(self, pages: List[int], new_total_tokens: int) -> bool:
         """Decode-time page growth with the eviction backstop."""
+        if self.fault_plan is not None and self.fault_plan.blocked("extend"):
+            return False  # injected allocation pressure
         if self.alloc.extend(pages, new_total_tokens):
             return True
         if self.prefix_cache is None:
@@ -1171,6 +1295,163 @@ class ModelRuntime:
             self.last_tokens[slot] = tok
             self.seq_lens[slot] = n
 
+    # -- preemption with recompute -----------------------------------------
+    KV_EXHAUSTED_MSG = ("KV page pool exhausted mid-decode and preemption "
+                       "is disabled; retry, shorten the prompt, or raise "
+                       "--num-pages")
+
+    def _pick_victim(self) -> Optional[int]:
+        """Victim slot for a preemption: lowest fair-share priority first
+        (the user with the most lifetime served requests), youngest
+        arrival as tie-break — NEVER the VIP, never a request that spent
+        its preemption budget (anti-livelock: it holds a reservation).
+        Stalled reservation-holders under budget still qualify — they
+        hold pages too. None = nobody is preemptible."""
+        vip = None
+        users: dict = {}
+        try:
+            snap = self.core_snapshot_for_preempt()
+            vip = snap.get("vip")
+            users = snap.get("users", {})
+        except Exception:
+            pass  # degraded victim pick (age only) beats no preemption
+        best, best_key = None, None
+        for i, r in enumerate(self.slot_req):
+            if r is None or r.preemptions >= self.ecfg.preempt_max:
+                continue
+            if vip is not None and r.user == vip:
+                continue
+            served = users.get(r.user, {}).get("processed", 0)
+            key = (served, r.stats.enqueued_at)
+            if best_key is None or key > best_key:
+                best, best_key = i, key
+        return best
+
+    # Seam for _pick_victim's policy inputs: the engine loop owns `core`
+    # only inside step calls, so the snapshot source is stashed per call.
+    def core_snapshot_for_preempt(self) -> dict:
+        core = getattr(self, "_preempt_core", None)
+        return core.snapshot() if core is not None else {}
+
+    def _preempt_slot(self, slot: int, core: MQCore) -> None:
+        """Evict `slot` for recompute: snapshot prompt + generated tokens,
+        merge the WRITTEN KV pages into the prefix cache (re-admission
+        then replays mostly from cache), free the rest, and hand the
+        request to the engine's requeue-front hook. The stream, the
+        incremental detokenizer, and generated_ids survive untouched, so
+        the client sees one seamless token stream across the preemption."""
+        req = self.slot_req[slot]
+        self.preempt_count += 1
+        self._tm_preempt.inc()
+        req.preemptions += 1
+        # KV is written for prompt + all generated tokens but the LAST
+        # sampled one (its write belongs to the decode step that never
+        # ran). The replay prompt carries that token too — its KV is
+        # recomputed by the re-prefill, and the forward samples the NEXT
+        # token, continuing the stream exactly where it stopped.
+        replay = req.prompt_tokens + req.generated_ids[req._replay_gen:]
+        written = len(replay) - 1 if req.generated_ids else len(replay)
+        req.trace_event("preempt", slot=slot, tokens=written,
+                        n=req.preemptions)
+        req.prompt_tokens = replay[:written]
+        self._release_slot_pages(slot, req if written else None)
+        req.prompt_tokens = replay
+        req._replay_gen = len(req.generated_ids)
+        self._clear_slot(slot)
+        hook = self.on_preempt
+        if hook is not None:
+            hook(req)  # False => hook finished it (blocked/expired)
+
+    def _page_exhausted(self, slot: int, need_tokens: int,
+                        core: MQCore) -> None:
+        """Decode-time page growth failed for `slot`. Never a silent
+        LENGTH: preempt a victim and retry, stall on a reservation, or —
+        with preemption off — error explicitly as kv_exhausted. A genuine
+        per-sequence context-cap hit is still an honest LENGTH (that IS
+        the context budget, not pool pressure)."""
+        pages = self.slot_pages[slot]
+        if (self.alloc.pages_needed(need_tokens) > self.alloc.max_pages_per_seq
+                or len(pages) >= self.alloc.max_pages_per_seq):
+            self._finish_slot(slot, FinishReason.LENGTH, core)
+            return
+        if self.on_preempt is None or not self.ecfg.preempt:
+            tm.SHED_TOTAL.labels(reason="kv_exhausted").inc()
+            self._finish_slot(slot, FinishReason.KV_EXHAUSTED, core,
+                              error=self.KV_EXHAUSTED_MSG)
+            return
+        self._preempt_core = core
+        try:
+            # Bounded: each pass preempts one victim or gives up — at
+            # most one pass per occupied slot, so an injected/persistent
+            # extend failure can't spin this loop forever.
+            for _ in range(len(self.slot_req)):
+                victim = self._pick_victim()
+                if victim is None:
+                    # Nobody preemptible: hold the reservation (slot +
+                    # pages), sit out dispatches until pages free up.
+                    self.slot_req[slot].trace_event(
+                        "kv_stall", pages=len(pages))
+                    self._stalled_slots.add(slot)
+                    return
+                self._preempt_slot(victim, core)
+                if self.slot_req[slot] is None:
+                    return  # this slot WAS the victim
+                if self._extend_pages(pages, need_tokens):
+                    self._stalled_slots.discard(slot)
+                    return
+            self.slot_req[slot].trace_event("kv_stall", pages=len(pages))
+            self._stalled_slots.add(slot)
+        finally:
+            self._preempt_core = None
+
+    # Reservation-holders may only stall this long with the whole batch
+    # blocked before the youngest is failed loudly (full-deadlock escape;
+    # any other slot finishing or a client cancel clears it sooner).
+    STALL_BREAK_S = 5.0
+
+    def _break_stall_deadlock(self, core: MQCore) -> None:
+        """Every active slot is a stalled reservation-holder and has been
+        for STALL_BREAK_S: nothing can finish, so nothing will ever free
+        pages. Fail the youngest reservation with the explicit exhaustion
+        error rather than wedging the runtime."""
+        youngest = max(self._stalled_slots,
+                       key=lambda i: self.slot_req[i].stats.enqueued_at)
+        tm.SHED_TOTAL.labels(reason="kv_exhausted").inc()
+        log.warning("breaking KV-reservation deadlock: failing slot %d "
+                    "(req %d)", youngest, self.slot_req[youngest].req_id)
+        self._finish_slot(youngest, FinishReason.KV_EXHAUSTED, core,
+                          error=self.KV_EXHAUSTED_MSG)
+
+    # -- fault-retry containment -------------------------------------------
+    def _retry_requeue(self, req: Request, queue: collections.deque,
+                       msg: str) -> bool:
+        """Queue a fault-implicated request for ONE more attempt on this
+        runtime (front of the pending queue, exponential backoff) —
+        False once its budget is spent or it's already gone (caller
+        errors it: poisoned inputs must not crash-loop the engine)."""
+        if req.retries >= self.ecfg.step_retries or req.cancelled.is_set():
+            return False
+        req.retries += 1
+        self.retry_count += 1
+        self._tm_retries.inc()
+        req._retry_at = time.monotonic() + (
+            self.ecfg.retry_backoff_s * (2 ** (req.retries - 1)))
+        req.trace_event("retry", error=msg[:200], n=req.retries)
+        queue.appendleft(req)
+        return True
+
+    def _retry_embed(self, req: Request, msg: str) -> bool:
+        return self._retry_requeue(req, self.pending_embed, msg)
+
+    def _poison_msg(self, req: Request, msg: str) -> str:
+        """Error text for a request whose retry budget is spent: the
+        client (and the log) must see that retries happened and stopped
+        on purpose."""
+        if req.retries:
+            return (f"{msg} (request poisoned after {req.retries} "
+                    f"retr{'y' if req.retries == 1 else 'ies'})")
+        return msg
+
     def step_chunk(self, core: MQCore) -> bool:
         """Advance ONE chunk of one long-prompt prefill. Returns True if a
         chunk ran (the engine loop interleaves these with decode steps)."""
@@ -1187,6 +1468,14 @@ class ModelRuntime:
             self.reserved_slots.discard(slot)
             core.mark_dropped(req.user)
             req.finish(FinishReason.CANCELLED)
+            return True
+        if req.expired():
+            # Deadline passed mid-chunked-prefill: stop burning chunks on
+            # a response nobody will wait for.
+            self.chunking.popleft()
+            self._release_slot_pages(slot)
+            self.reserved_slots.discard(slot)
+            drop_expired(req, core, self.name)
             return True
 
         s = req.sampling
@@ -1211,21 +1500,39 @@ class ModelRuntime:
         req.trace_event("prefill_chunk", pos=chunk_start, tokens=cl)
         t0 = time.monotonic()
         is_final = 1 if chunk_start + cl >= n else 0
-        tok, self.kc, self.vc, self.recent = self._dispatch_chunk(
-            chunk, tokens,
-            np.asarray([chunk_start], np.int32), np.asarray([cl], np.int32),
-            np.asarray([slot], np.int32), np.asarray([is_final], np.int32),
-            np.asarray([is_first], np.int32), seed_row,
-            req._pt_row,
-            np.asarray([s.temperature], np.float32),
-            np.asarray([s.top_k], np.int32),
-            np.asarray([s.top_p], np.float32),
-            np.asarray([s.repeat_penalty], np.float32),
-            np.asarray([s.presence_penalty], np.float32),
-            np.asarray([s.frequency_penalty], np.float32),
-            np.asarray([s.seed], np.int32),
-            self._next_key(),
-        )
+        try:
+            tok, self.kc, self.vc, self.recent = self._dispatch_chunk(
+                chunk, tokens,
+                np.asarray([chunk_start], np.int32), np.asarray([cl], np.int32),
+                np.asarray([slot], np.int32), np.asarray([is_final], np.int32),
+                np.asarray([is_first], np.int32), seed_row,
+                req._pt_row,
+                np.asarray([s.temperature], np.float32),
+                np.asarray([s.top_k], np.int32),
+                np.asarray([s.top_p], np.float32),
+                np.asarray([s.repeat_penalty], np.float32),
+                np.asarray([s.presence_penalty], np.float32),
+                np.asarray([s.frequency_penalty], np.float32),
+                np.asarray([s.seed], np.int32),
+                self._next_key(),
+            )
+        except Exception as e:
+            # Contain to THIS request: release the reserved slot's pages
+            # (and pinned prefix), retry once from scratch, else poison.
+            log.exception("chunked prefill failed for req %d",
+                          req.req_id, extra={"req_id": req.req_id})
+            self.chunking.popleft()
+            self._release_slot_pages(slot)
+            self.reserved_slots.discard(slot)
+            desync = isinstance(e, WorkerDesyncError)
+            if desync or not self._retry_requeue(
+                    req, self.pending_prefill, f"prefill failed: {e}"):
+                core.mark_dropped(req.user)
+                req.finish(FinishReason.ERROR, error=self._poison_msg(
+                    req, f"prefill failed: {e}"))
+            if desync:
+                raise  # diverged SPMD state: the runtime must kill+reload
+            return True
         self.prefill_latency_ms = (time.monotonic() - t0) * 1e3
         self._tm_prefill.observe(self.prefill_latency_ms)
         req._chunk_pos = chunk_start + cl
@@ -1256,26 +1563,50 @@ class ModelRuntime:
         so dp replicas' fused scans — which live on disjoint device sets —
         execute concurrently instead of serializing on the host thread
         (round-2 verdict weak #1). Returns None when nothing is active."""
-        active = [i for i, r in enumerate(self.slot_req) if r is not None]
-        if not active:
+        if not any(r is not None for r in self.slot_req):
             return None
+        # Reservation-holders first: pages may have freed since they
+        # stalled — growth success puts them back into the batch.
+        for i in sorted(self._stalled_slots):
+            if self.slot_req[i] is None:
+                self._stalled_slots.discard(i)
+            elif self._extend_pages(self.slot_pages[i],
+                                    int(self.seq_lens[i]) + k_steps):
+                self._stalled_slots.discard(i)
         # Ensure page headroom for k_steps new tokens per active slot.
-        for i in active:
+        for i, r in enumerate(self.slot_req):
+            if r is None or i in self._stalled_slots:
+                continue
             need = int(self.seq_lens[i]) + k_steps
             if not self._extend_pages(self.slot_pages[i], need):
-                # Pool exhausted or per-seq cap: end this sequence here.
-                self._finish_slot(i, FinishReason.LENGTH, core)
-            else:
+                # Never a silent LENGTH: preempt-with-recompute, stall on
+                # a reservation, or error explicitly (kv_exhausted).
+                self._page_exhausted(i, need, core)
+            if self.slot_req[i] is not None and i not in self._stalled_slots:
                 self.page_table[i, :] = kvc.make_page_table_row(
                     self.slot_pages[i], self.ecfg.max_pages_per_seq
                 )
-        active = [i for i, r in enumerate(self.slot_req) if r is not None]
+        active = [i for i, r in enumerate(self.slot_req)
+                  if r is not None and i not in self._stalled_slots]
         if not active:
+            # Whole batch is stalled reservations: nothing can finish, so
+            # nothing will free pages — after a grace window, break the
+            # deadlock loudly instead of wedging (any other in-flight
+            # work, e.g. a chunked prefill, can still unblock it first).
+            if self._stalled_slots and not self.chunking:
+                now = time.monotonic()
+                if self._stall_since is None:
+                    self._stall_since = now
+                elif now - self._stall_since > self.STALL_BREAK_S:
+                    self._break_stall_deadlock(core)
+                    self._stall_since = None
             return None
+        self._stall_since = None
 
         t0 = time.monotonic()
         active_mask = np.asarray(
-            [1 if r is not None else 0 for r in self.slot_req], np.int32
+            [1 if (r is not None and i not in self._stalled_slots) else 0
+             for i, r in enumerate(self.slot_req)], np.int32
         )
 
         if (self.attn_impl == "pallas" and not self._pallas_proven
@@ -1417,6 +1748,7 @@ class ModelRuntime:
     # Dispatch seam: the SPMD subclass broadcasts (OP_EMBED, payload) to
     # worker hosts before issuing the same jit call.
     def _dispatch_embed(self, B, bucket, tokens, lens):
+        self._fault("embed")
         return self._get_embed_jit(B, bucket)(
             self.params, jnp.asarray(tokens), jnp.asarray(lens)
         )
@@ -1461,6 +1793,9 @@ class ModelRuntime:
             "ttft_p50_ms": pctl(self.ttft_window, 0.50),
             "ttft_p99_ms": pctl(self.ttft_window, 0.99),
             "tokens_generated": self.tokens_generated,
+            "preemptions": self.preempt_count,
+            "retries": self.retry_count,
+            "stalled_slots": len(self._stalled_slots),
             "mfu": round(self.mfu, 4),
             "param_bytes": self.param_bytes,
             "kv_bytes": self.kv_bytes,
@@ -1476,6 +1811,8 @@ class EncoderRuntime:
     """Embedding model runtime: batch encode, no KV cache."""
 
     slo = None  # encoders emit no tokens; attached but never recorded into
+    fault_plan = None  # attached by the engine like ModelRuntime's
+    on_preempt = None  # encoders hold no KV pages; attached but unused
 
     def __init__(self, name, model_cfg, engine_cfg, mesh=None,
                  checkpoint_path=None, dtype=jnp.bfloat16):
@@ -1532,6 +1869,9 @@ class EncoderRuntime:
     # Dispatch seam: the SPMD subclass broadcasts (OP_ENCODE, payload) to
     # worker hosts before issuing the same jit call.
     def _dispatch_encode(self, B, bucket, tokens, lens):
+        if self.fault_plan is not None and not getattr(self, "_spmd", False):
+            # (multi-host: the check runs pre-broadcast in the SPMD seam)
+            self.fault_plan.check("encode")
         return self._get_jit(B, bucket)(
             self.params, jnp.asarray(tokens), jnp.asarray(lens)
         )
@@ -1555,6 +1895,9 @@ class EncoderRuntime:
             "step_latency_ms": round(self.step_latency_ms, 3),
             "prefill_latency_ms": 0.0,
             "tokens_generated": self.tokens_generated,
+            "preemptions": 0,  # encoders hold no decode slots to preempt
+            "retries": 0,
+            "stalled_slots": 0,
             "mfu": 0.0,  # encoders don't publish decode-step MFU
             "param_bytes": self.param_bytes,
             "kv_bytes": self.kv_bytes,
@@ -1695,6 +2038,7 @@ class ReplicaSet:
         agg = dict(per[0])
         for key in ("active_slots", "max_slots", "pending_prefill",
                     "pages_used", "pages_total", "tokens_generated",
+                    "preemptions", "retries", "stalled_slots",
                     "param_bytes", "kv_bytes"):
             agg[key] = sum(p[key] for p in per)
         for key in ("step_latency_ms", "step_p50_ms", "step_p99_ms",
@@ -1736,6 +2080,10 @@ class TPUEngine:
         self.dtype = dtype if dtype is not None else jnp.dtype(engine_cfg.dtype)
         self.runtimes: Dict[str, object] = {}
         self.pending: Dict[int, Request] = {}
+        # Load-shed accounting by reason (mirrors ollamamq_shed_total;
+        # kept engine-side too so the TUI chip needs no registry walk).
+        self.shed_counts: Dict[str, int] = {}
+        self._engine_retries = 0  # retries issued by _retry_or_error
         self._orphans: List[tuple] = []
         self._expired_orphans: Dict[int, float] = {}
         self._last_stuck_log = 0.0
@@ -1765,6 +2113,17 @@ class TPUEngine:
         # top of every _loop_once, so a dispatch wedged inside a step
         # leaves it stale while work is pending.
         self.last_tick_at = time.monotonic()
+        # Deterministic fault injection: a plan path (--fault-plan) loads
+        # here — fail-fast on a malformed file — or tests hand an already
+        # built FaultPlan instance via EngineConfig.fault_plan.
+        self.fault_plan = None
+        if engine_cfg.fault_plan:
+            from ollamamq_tpu.testing.faults import FaultPlan
+
+            self.fault_plan = (
+                FaultPlan.load(engine_cfg.fault_plan)
+                if isinstance(engine_cfg.fault_plan, str)
+                else engine_cfg.fault_plan)
         # CPU-gloo can't run two cross-host computations concurrently: XLA's
         # CPU thread pool executes them in nondeterministic order and their
         # collective ops interleave differently per process on the shared
@@ -1800,11 +2159,19 @@ class TPUEngine:
             self.runtime_class, self.encoder_runtime_class,
         )
         for rep in reps:
-            rep.slo = self.slo  # primary-side SLO accounting hook
+            self._attach_hooks(rep)
         self.runtimes[name] = reps[0] if len(reps) == 1 else ReplicaSet(reps)
         log.info("loaded model %s (%.1f MB params)", name,
                  self.runtimes[name].param_bytes / 1e6)
         self.notify()
+
+    def _attach_hooks(self, rep) -> None:
+        """Primary-side engine hooks on a (re)built runtime: SLO
+        accounting, fault injection, and the preemption requeue path."""
+        rep.slo = self.slo
+        rep.fault_plan = self.fault_plan
+        if self.ecfg.preempt:
+            rep.on_preempt = self._requeue_preempted
 
     def evict_model(self, name: str) -> bool:
         rt = self.runtimes.get(name)
@@ -1832,7 +2199,19 @@ class TPUEngine:
     ) -> Request:
         """Atomically enqueue into the native core AND register the Request,
         so the engine loop can never pop a req_id it doesn't know yet.
-        Raises BlockedError for blocked users/IPs."""
+        Raises BlockedError for blocked users/IPs, QueueFullError when a
+        bounded-admission cap (--max-queued / --max-queued-per-user) is
+        hit — honest backpressure instead of an unbounded queue."""
+        cfg = self.ecfg
+        if cfg.max_queued and self.core.total_queued() >= cfg.max_queued:
+            self._count_shed("queue_full")
+            raise QueueFullError("queue_full", self.retry_after_s(),
+                                 cfg.max_queued)
+        if (cfg.max_queued_per_user
+                and self.core.queue_len(user) >= cfg.max_queued_per_user):
+            self._count_shed("user_queue_full")
+            raise QueueFullError("user_queue_full", self.retry_after_s(),
+                                 cfg.max_queued_per_user)
         with self._pending_lock:
             rid = self.core.enqueue(
                 user, ip, model,
@@ -1866,6 +2245,97 @@ class TPUEngine:
             return
         self.notify()
 
+    def _count_shed(self, reason: str) -> None:
+        tm.SHED_TOTAL.labels(reason=reason).inc()
+        self.shed_counts[reason] = self.shed_counts.get(reason, 0) + 1
+
+    def retry_after_s(self) -> float:
+        """Retry-After estimate for shed responses: queue depth over the
+        OBSERVED completion rate (recent finish timestamps from the
+        tracer), clamped to [1, 300]. No completions observed yet =>
+        a conservative small default — better an honest guess than a
+        magic constant pretending precision."""
+        queued = max(1, self.core.total_queued())
+        window = getattr(self.tracer, "finish_times", None)
+        if window and len(window) >= 2:
+            span = window[-1] - window[0]
+            if span > 0:
+                rate = (len(window) - 1) / span  # completions per second
+                return float(min(300.0, max(1.0, queued / rate)))
+        return float(min(30.0, max(1.0, queued)))
+
+    def _requeue_preempted(self, req: Request) -> bool:
+        """on_preempt hook: return a preempted request to the FRONT of
+        its user's native queue for recompute re-admission. False => the
+        request could not be requeued (cancelled/expired/blocked) and was
+        finished here — its pages are already released by the caller."""
+        if req.cancelled.is_set():
+            self.core.mark_dropped(req.user)
+            req.finish(FinishReason.CANCELLED)
+            return False
+        if req.expired():
+            # Deadline check at preemption re-admission: recompute for a
+            # response nobody will wait for is pure waste.
+            drop_expired(req, self.core, req.model)
+            return False
+        try:
+            with self._pending_lock:
+                new_rid = self.core.requeue_front(req.user, "", req.model,
+                                                  kind=req.kind)
+                req.req_id = new_rid
+                self.pending[new_rid] = req
+            req.trace_event("requeue")
+            self.notify()
+            return True
+        except BlockedError:
+            self.core.mark_dropped(req.user)
+            req.finish(FinishReason.CANCELLED)
+            return False
+
+    def _retry_or_error(self, req: Request, msg: str,
+                        replay: bool = False) -> None:
+        """Route a request implicated in a runtime failure: one retried
+        dispatch via the front of its user's native queue (backoff
+        honored by the runtime's pending gate), or a poisoned explicit
+        error once the budget is spent. `replay=True` folds generated
+        ids into the prompt so a mid-decode victim resumes its stream."""
+        started = getattr(req, "started", True)
+        if req.cancelled.is_set():
+            self.core.mark_dropped(req.user, started=started)
+            req.finish(FinishReason.CANCELLED)
+            return
+        if req.expired():
+            drop_expired(req, self.core, req.model)
+            return
+        if req.retries >= self.ecfg.step_retries:
+            self.core.mark_dropped(req.user, started=started)
+            req.finish(FinishReason.ERROR, error=(
+                f"{msg} (request poisoned after {req.retries} retr"
+                f"{'y' if req.retries == 1 else 'ies'})"))
+            return
+        req.retries += 1
+        self._engine_retries += 1
+        tm.RETRIES_TOTAL.labels(model=req.model or "?").inc()
+        req._retry_at = time.monotonic() + (
+            self.ecfg.retry_backoff_s * (2 ** (req.retries - 1)))
+        if replay and req.generated_ids:
+            # Resume-from-failure recompute: the fresh runtime re-prefills
+            # prompt + everything already streamed, then continues.
+            req.prompt_tokens = (req.prompt_tokens
+                                 + req.generated_ids[req._replay_gen:])
+            req._replay_gen = len(req.generated_ids)
+        req.trace_event("retry", error=msg[:200], n=req.retries)
+        try:
+            with self._pending_lock:
+                new_rid = self.core.requeue_front(req.user, "", req.model,
+                                                  kind=req.kind)
+                req.req_id = new_rid
+                self.pending[new_rid] = req
+            self.notify()
+        except BlockedError:
+            self.core.mark_dropped(req.user, started=started)
+            req.finish(FinishReason.CANCELLED)
+
     def cancel(self, req_id: int) -> None:
         with self._pending_lock:
             req = self.pending.get(req_id)
@@ -1888,6 +2358,7 @@ class TPUEngine:
                     list(getattr(rt, "slot_req", []))
                     + list(getattr(rt, "active", []))
                     + list(getattr(rt, "pending_prefill", []))
+                    + list(getattr(rt, "pending_embed", []))
                     + list(getattr(rt, "chunking", []))
                     + list(getattr(rt, "inflight_prefill", []))
                     + list(getattr(rt, "pending", []))
@@ -2097,8 +2568,13 @@ class TPUEngine:
         # Late re-check (dispatcher.rs:503-512): client gone OR user/IP
         # blocked after enqueueing ⇒ drop, never serve.
         if req.cancelled.is_set() or self.core.is_user_or_ip_blocked(user):
-            self.core.mark_dropped(user, started=False)
+            self.core.mark_dropped(user, started=req.started)
             req.finish(FinishReason.CANCELLED)
+            return False
+        if req.expired():
+            # Deadline check at admission: an expired pop is dropped here,
+            # before it can claim a slot or a prefill forward.
+            drop_expired(req, self.core, model)
             return False
         rt = self.resolve_runtime(model, kind=req.kind)
         if rt is None and model:
@@ -2110,7 +2586,7 @@ class TPUEngine:
             # gate, so requeueing it would spin.
             return self._requeue(req, user, model)
         if rt is None:
-            self.core.mark_dropped(user, started=False)
+            self.core.mark_dropped(user, started=req.started)
             req.finish(FinishReason.ERROR, error=f"model not loaded: {model}")
             return False
         # Named-model kind check: generate on an encoder would "finish"
@@ -2120,7 +2596,7 @@ class TPUEngine:
         # that opt out of embedding.)
         probe = rt.replicas[0] if isinstance(rt, ReplicaSet) else rt
         if req.kind not in getattr(probe, "SERVES", ("generate",)):
-            self.core.mark_dropped(user, started=False)
+            self.core.mark_dropped(user, started=req.started)
             req.finish(FinishReason.ERROR, error=(
                 f"model {model or probe.name} is an embedding-only model"
                 if req.kind == "generate"
@@ -2137,7 +2613,11 @@ class TPUEngine:
             # requeue would spin; park on the least-loaded live replica.
             rt.force_submit(req)
         req.trace_event("place", runtime=getattr(rt, "name", model))
-        self.core.mark_started(user)
+        if not req.started:
+            # Preempted/retried requeues were already counted as started;
+            # a second mark would leak a processing count forever.
+            self.core.mark_started(user)
+            req.started = True
         return True
 
     def _requeue(self, req: Request, user: str, model: str) -> bool:
@@ -2261,7 +2741,10 @@ class TPUEngine:
                                 rt.step_decode_collect(h, self.core)
                             else:
                                 handles.append((rt, h))
-                        did_work = True
+                            did_work = True
+                        # h None with slots occupied = every occupant is a
+                        # stalled page reservation: nap on the condvar
+                        # (did_work stays False) instead of spinning.
                 else:
                     if rt.has_work():
                         rt.step(self.core)
@@ -2341,7 +2824,7 @@ class TPUEngine:
                 return
             items, self._rebuilt = self._rebuilt, []
         for rt, fresh in items:
-            fresh.slo = self.slo
+            self._attach_hooks(fresh)
             if hasattr(rt, "spmd_index"):
                 fresh.spmd_index = rt.spmd_index
                 fresh.spmd_replica = getattr(rt, "spmd_replica", 0)
@@ -2363,7 +2846,12 @@ class TPUEngine:
             self.notify()
 
     def _fail_runtime(self, rt, msg: str) -> None:
-        """Fail all requests held by a runtime after an unrecoverable error."""
+        """Contain a runtime-step failure to the implicated requests: each
+        one is retried ONCE on a fresh dispatch (front of its user's
+        queue, exponential backoff; mid-decode victims replay
+        prompt+generated so their stream resumes seamlessly after the
+        rebuild), and requests that keep failing are poisoned with an
+        explicit error — one bad input can't crash-loop the engine."""
         try:
             if isinstance(rt, ModelRuntime):
                 for i, req in enumerate(rt.slot_req):
@@ -2371,15 +2859,17 @@ class TPUEngine:
                         rt._release_slot_pages(i)
                         rt.seq_lens[i] = 0
                         rt.slot_req[i] = None
-                        self.core.mark_dropped(req.user)
-                        req.finish(FinishReason.ERROR, error=msg)
+                        self._retry_or_error(req, msg, replay=True)
+                rt._stalled_slots.clear()
+            act = getattr(rt, "active", None)
+            if isinstance(act, list):  # FakeRuntime's slot table
+                while act:
+                    self._retry_or_error(act.pop(), msg, replay=True)
             for attr in ("pending_prefill", "pending_embed", "chunking",
                          "pending"):
                 pending = getattr(rt, attr, None)
                 while pending:
-                    req = pending.popleft()
-                    self.core.mark_dropped(req.user)
-                    req.finish(FinishReason.ERROR, error=msg)
+                    self._retry_or_error(pending.popleft(), msg)
             if hasattr(rt, "reserved_slots"):
                 for slot in list(rt.reserved_slots):
                     rt._release_slot_pages(slot)
@@ -2418,6 +2908,16 @@ class TPUEngine:
         return self.call_on_loop(_do)
 
     # -- telemetry ---------------------------------------------------------
+    def preemption_count(self) -> int:
+        """Total KV-pressure preemptions across runtimes (TUI chip; the
+        health monitor's preemption-storm rule rates this)."""
+        return sum(getattr(rt, "preempt_count", 0)
+                   for rt in self._step_targets())
+
+    def retry_count(self) -> int:
+        return self._engine_retries + sum(
+            getattr(rt, "retry_count", 0) for rt in self._step_targets())
+
     def chip_stats(self) -> List[dict]:
         """Per-chip rows; the SPMD engine overrides to merge worker
         hosts' chips from the KV store."""
@@ -2454,4 +2954,9 @@ class TPUEngine:
             "uptime_s": round(time.time() - self.started_at, 1),
             "health": health.status() if (health := self.health) else None,
             "queue": self.core.snapshot(),
+            # Degradation counters: sheds by reason (admission caps,
+            # deadlines, kv exhaustion) + total preemptions/retries.
+            "shed": dict(self.shed_counts),
+            "preemptions": self.preemption_count(),
+            "retries": self.retry_count(),
         }

@@ -10,6 +10,7 @@ conformance-tested without a TPU in the loop.
 from __future__ import annotations
 
 import collections
+import logging
 import time
 from typing import Dict, List, Optional
 
@@ -19,11 +20,15 @@ from ollamamq_tpu.engine.request import FinishReason, Request, StreamItem
 from ollamamq_tpu.engine.tokenizer import ByteTokenizer
 from ollamamq_tpu.telemetry import schema as tm
 
+log = logging.getLogger("ollamamq.fake")
+
 
 class FakeRuntime:
     """Generates `word0 word1 ...` tokens, one per step, per active request."""
 
     slo = None  # attached by FakeEngine.load_model, like ModelRuntime
+    fault_plan = None  # deterministic fault injection (testing/faults.py)
+    on_preempt = None  # attached like ModelRuntime's (unused by fakes)
 
     def __init__(self, name: str, engine_cfg: EngineConfig,
                  token_latency_s: float = 0.0, is_encoder: bool = False):
@@ -74,13 +79,26 @@ class FakeRuntime:
                 req.finish(FinishReason.CANCELLED)
 
     def step(self, core) -> None:
+        # Fault seam: the fake analogue of ModelRuntime's dispatch hooks,
+        # so shedding/retry/watchdog paths are testable without jax.
+        if self.fault_plan is not None:
+            self.fault_plan.check("step")
         # Admit everything pending (fake engine has no real slot pressure).
         # NOTE: core.mark_started already ran in TPUEngine._admit.
         while self.pending_prefill:
+            if self.pending_prefill[0]._retry_at > time.monotonic():
+                break  # head is backing off after a contained fault
             req = self.pending_prefill.popleft()
             if req.cancelled.is_set():
                 core.mark_dropped(req.user)
                 req.finish(FinishReason.CANCELLED)
+                continue
+            if req.expired():
+                # Same deadline semantics as the real engine: expired
+                # queued work drops before any "compute" is spent.
+                from ollamamq_tpu.engine.engine import drop_expired
+
+                drop_expired(req, core, self.name)
                 continue
             if self.is_encoder or req.kind == "embed":
                 req.trace_event("embed_batch", tokens=len(req.prompt_tokens))
@@ -90,8 +108,14 @@ class FakeRuntime:
                 req.finish(FinishReason.STOP)
             else:
                 req.trace_event("prefill", tokens=len(req.prompt_tokens))
-                req._fake_remaining = min(req.sampling.max_tokens, 16)
-                req._fake_idx = 0
+                # Resume-aware: a retried request (engine containment
+                # path) continues its word stream where it stopped rather
+                # than restarting at word0 — mirrors the real engine's
+                # replay-recompute continuity.
+                done = len(req.generated_ids)
+                req._fake_remaining = max(
+                    1, min(req.sampling.max_tokens, 16) - done)
+                req._fake_idx = done
                 self.active.append(req)
         self._tm_occupancy.set(len(self.active) / max(1, self.ecfg.max_slots))
         if self.token_latency_s:
@@ -156,6 +180,9 @@ class FakeRuntime:
             "step_latency_ms": round(self.token_latency_s * 1e3, 3),
             "prefill_latency_ms": 0.0,
             "tokens_generated": self.tokens_generated,
+            "preemptions": 0,  # fakes hold no KV pages to run out of
+            "retries": 0,
+            "stalled_slots": 0,
             "mfu": 0.0,
             "param_bytes": self.param_bytes,
             "kv_bytes": self.kv_bytes,
@@ -184,6 +211,7 @@ class FakeEngine(TPUEngine):
             name, self.ecfg, token_latency_s=self.token_latency_s, is_encoder=is_enc
         )
         rt.slo = self.slo
+        rt.fault_plan = self.fault_plan
         self.runtimes[name] = rt
         self.notify()
 
@@ -195,7 +223,14 @@ class FakeEngine(TPUEngine):
             for rt in list(self.runtimes.values()):
                 rt.check_cancellations(self.core)
                 if rt.has_work():
-                    rt.step(self.core)
+                    try:
+                        rt.step(self.core)
+                    except Exception:
+                        # Same containment contract as the real engine:
+                        # retry-or-poison the implicated requests, keep
+                        # the loop (and the fake runtime) alive.
+                        log.exception("fake runtime %s step failed", rt.name)
+                        self._fail_runtime(rt, "engine step failed")
                     did_work = True
             if not did_work:
                 with self._cond:

@@ -42,6 +42,12 @@ STALL_DEADLINE_S = 30.0
 # per chunk and a decode stream an event every 16 tokens, so any healthy
 # request beats this by orders of magnitude.
 REQUEST_STALL_S = 120.0
+# Preemption-storm rule: occasional KV-pressure preemptions are the
+# system degrading gracefully; this many per minute means the page pool
+# is undersized for the live workload and recompute is eating throughput
+# (alert "preempt_storm", resolves when the rate drops).
+PREEMPT_STORM_PER_MIN = 30.0
+PREEMPT_STORM_WINDOW_S = 60.0
 
 
 class HealthMonitor:
@@ -60,6 +66,8 @@ class HealthMonitor:
         self.engine_stalled = False
         self.last_device_check = 0.0
         self._last_progress = (0, time.monotonic())  # (tokens, ts)
+        # (ts, cumulative preemptions) samples for the storm-rate window.
+        self._preempt_samples: list = []
 
     @property
     def stall_s(self) -> float:
@@ -223,9 +231,53 @@ class HealthMonitor:
             f"SPMD worker host(s) {stale} stopped publishing registry "
             "snapshots/heartbeats", "worker_host")
 
+        self._check_preempt_storm()
+
         slo = getattr(self.engine, "slo", None)
         if slo is not None:
             slo.evaluate()
+
+    def preempt_rate_per_min(self) -> float:
+        """Preemptions per minute over the storm window, from cumulative
+        engine counts sampled at the check cadence."""
+        count_fn = getattr(self.engine, "preemption_count", None)
+        if count_fn is None:
+            return 0.0
+        now = time.monotonic()
+        self._preempt_samples.append((now, int(count_fn())))
+        cutoff = now - PREEMPT_STORM_WINDOW_S
+        self._preempt_samples = [
+            (t, c) for t, c in self._preempt_samples if t >= cutoff
+        ][-64:]
+        if len(self._preempt_samples) < 2:
+            return 0.0
+        t0, c0 = self._preempt_samples[0]
+        t1, c1 = self._preempt_samples[-1]
+        span = t1 - t0
+        if span <= 0:
+            return 0.0
+        # Rebuilds reset per-runtime counters; a negative delta is a
+        # reset, not negative preemptions.
+        return max(0, c1 - c0) * 60.0 / span
+
+    def _check_preempt_storm(self) -> None:
+        """AlertManager rule for preemption storms: sustained KV-pressure
+        preemptions above PREEMPT_STORM_PER_MIN mean the pool is
+        undersized and recompute is eating throughput. Not routed through
+        _alert: a storm is degradation pressure, not a watchdog stall, so
+        it must not count into ollamamq_watchdog_stalls_total."""
+        alerts = getattr(self.engine, "alerts", None)
+        if alerts is None:
+            return
+        rate = self.preempt_rate_per_min()
+        if rate > PREEMPT_STORM_PER_MIN:
+            alerts.fire(
+                "preempt_storm", "warn",
+                f"preemption storm: {rate:.0f} preemptions/min under KV "
+                "pressure (pool undersized for the live workload; "
+                "recompute is eating throughput)", source="watchdog")
+        else:
+            alerts.resolve("preempt_storm")
 
     def status(self) -> dict:
         alerts = getattr(self.engine, "alerts", None)

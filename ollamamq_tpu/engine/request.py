@@ -26,6 +26,18 @@ class FinishReason(str, enum.Enum):
     LENGTH = "length"      # max_tokens or context budget hit
     CANCELLED = "cancelled"  # client disconnected / admin drop
     ERROR = "error"
+    # Degradation-specific terminals: the client must be able to tell an
+    # honest resource/deadline failure from a generic engine error, so
+    # these surface as their own API done_reason (never folded into
+    # "length" or a bare "error").
+    KV_EXHAUSTED = "kv_exhausted"  # decode-time page-pool exhaustion
+    DEADLINE = "deadline"          # per-request deadline expired
+
+
+# Terminal reasons delivered to the client as an "error" stream item
+# (with finish_reason carrying the specific done_reason).
+ERROR_REASONS = (FinishReason.ERROR, FinishReason.KV_EXHAUSTED,
+                 FinishReason.DEADLINE)
 
 
 @dataclasses.dataclass
@@ -153,6 +165,26 @@ class Request:
         self.stream = TokenStream()
         self.stats = RequestStats(prompt_tokens=len(self.prompt_tokens))
         self.cancelled = threading.Event()
+        # Per-request deadline (monotonic instant), from the sampling
+        # params' deadline_ms budget (header or options). None = none.
+        dm = float(getattr(self.sampling, "deadline_ms", 0.0) or 0.0)
+        self.deadline = (self.stats.enqueued_at + dm / 1e3) if dm > 0 else None
+        # Scheduler-accounting flag: True once mark_started ran for this
+        # request — a preempted/retried requeue must not double-count it.
+        self.started = False
+        # Graceful-degradation state (engine-owned): preemption count
+        # (anti-livelock budget), fault-retry count (poisoning budget),
+        # earliest next retry attempt, and how many generated ids are
+        # already folded into prompt_tokens for recompute replay.
+        self.preemptions = 0
+        self.retries = 0
+        self._retry_at = 0.0
+        self._replay_gen = 0
+        # Incremental detokenizer: attached at first runtime submit and
+        # PRESERVED across preemption/retry requeues — the replay prompt
+        # carries already-generated ids, so the decoder must not re-see
+        # them (stream continuity).
+        self._inc_decode = None
         # Lifecycle trace (telemetry.tracing.Trace), attached by the
         # engine's enqueue path; None for directly-constructed Requests
         # (bench, unit tests) — every trace hook below no-ops then.
@@ -204,6 +236,12 @@ class Request:
     def full_text(self) -> str:
         return self._detok_text[: self.emitted_len]
 
+    def expired(self, now: Optional[float] = None) -> bool:
+        """True when the request's deadline has passed."""
+        if self.deadline is None:
+            return False
+        return (now if now is not None else time.monotonic()) >= self.deadline
+
     def trace_event(self, name: str, **args) -> None:
         """Record a lifecycle span event; no-op for untraced requests."""
         tr = self.trace
@@ -212,7 +250,7 @@ class Request:
 
     def finish(self, reason: FinishReason, error: str = "") -> None:
         self.stats.finished_at = time.monotonic()
-        kind = "error" if reason == FinishReason.ERROR else "done"
+        kind = "error" if reason in ERROR_REASONS else "done"
         self.stream.push(StreamItem(kind, finish_reason=reason, error=error))
         tr = self.trace
         if tr is not None:

@@ -500,12 +500,24 @@ def _raise_on_worker_failure(flags: Optional[np.ndarray], name: str) -> None:
         )
 
 
+_OP_SITE = {OP_PREFILL: "prefill", OP_CHUNK: "chunk", OP_DECODE: "decode",
+            OP_PREFILL_SP: "sp_prefill", OP_EMBED: "embed",
+            OP_ENCODE: "encode"}
+
+
 def _mirrored_dispatch(rt, op, a, b, values, dispatch):
     """Ship the plan, run the local dispatch, then join this runtime's
     status sync. The status sync runs even when the local dispatch raised —
     skipping it would strand the other hosts at the barrier. Shared by the
     generative and encoder SPMD runtimes so the sync protocol can't drift
     between them."""
+    if rt.fault_plan is not None:
+        # Fault-injection seam, BEFORE the broadcast: an injected host
+        # failure must fire while no worker has replayed anything, so the
+        # containment/retry path sees a recoverable fault — a
+        # post-broadcast failure is real KV divergence, which is the
+        # desync path's job, not injection's.
+        rt.fault_plan.check(_OP_SITE.get(op, "decode"))
     _send(op, a, b, rt.spmd_index, rt.spmd_replica, values,
           rt.ecfg.max_slots, rt.ecfg.max_pages_per_seq,
           rt.ecfg.repeat_last_n)
@@ -546,6 +558,13 @@ class SPMDModelRuntime(ModelRuntime):
 
     def _mirrored(self, op, a, b, values, dispatch):
         return _mirrored_dispatch(self, op, a, b, values, dispatch)
+
+    def _fault(self, site):
+        # Multi-host: the check already ran pre-broadcast in
+        # _mirrored_dispatch; firing again here would double-count the
+        # plan's per-site call stream.
+        if not self._spmd:
+            super()._fault(site)
 
     def _dispatch_prefill(self, bucket, B, tokens, lens, slot_ids, pt_rows,
                           temp, tk, tp, pen, pres, freq, seeds, key):

@@ -32,8 +32,18 @@ class SamplingParams:
     seed: "int | None" = None  # stored as int32 > 0 after __post_init__
     max_tokens: int = 256
     stop: tuple = ()
+    # Per-request deadline budget in ms from enqueue (0 = none). Not a
+    # sampling knob, but it rides the options/body like one (and the
+    # X-Deadline-Ms header overrides it): expired requests are dropped
+    # at admission / before prefill instead of burning TPU time.
+    deadline_ms: float = 0.0
 
     def __post_init__(self):
+        # Non-positive / junk deadlines mean "no deadline".
+        try:
+            self.deadline_ms = max(0.0, float(self.deadline_ms or 0.0))
+        except (TypeError, ValueError):
+            self.deadline_ms = 0.0
         # Seeds ride int32 device arrays; an out-of-range value would raise
         # OverflowError in the engine thread (numpy 2 rejects lossy int32
         # assignment) and fail every in-flight request on the runtime. Fold
@@ -56,6 +66,7 @@ class SamplingParams:
             seed=options.get("seed"),  # absent/null => None => unseeded
             max_tokens=int(options.get("num_predict", max_tokens_default) or max_tokens_default),
             stop=tuple(options.get("stop", []) or []),
+            deadline_ms=options.get("deadline_ms", 0.0),
         )
 
     @classmethod
@@ -77,6 +88,8 @@ class SamplingParams:
                 body.get("max_tokens") or body.get("max_completion_tokens") or max_tokens_default
             ),
             stop=tuple(stop),
+            # Not an OpenAI field either; same pass-through rationale.
+            deadline_ms=body.get("deadline_ms", 0.0),
         )
 
 

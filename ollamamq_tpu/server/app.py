@@ -29,6 +29,7 @@ from aiohttp import web
 from ollamamq_tpu import __version__
 from ollamamq_tpu.config import get_model_config
 from ollamamq_tpu.core.mqcore import BlockedError, Family
+from ollamamq_tpu.engine.engine import QueueFullError
 from ollamamq_tpu.engine.request import FinishReason, Request, StreamItem
 from ollamamq_tpu.ops.sampling import SamplingParams
 from ollamamq_tpu.server.registry import ModelRegistry
@@ -80,10 +81,11 @@ def _ns(seconds: float) -> int:
 
 
 class ApiError(web.HTTPException):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str, headers: dict = None):
         self.status_code = status
         super().__init__(
-            text=json.dumps({"error": message}), content_type="application/json"
+            text=json.dumps({"error": message}),
+            content_type="application/json", headers=headers,
         )
 
 
@@ -181,6 +183,30 @@ class Server:
             )
         except BlockedError as e:
             raise ApiError(403, str(e))
+        except QueueFullError as e:
+            # Bounded admission: per-user cap => 429 (this client should
+            # back off), global cap => 503 (the service is saturated).
+            # Retry-After derives from the observed completion rate, not
+            # a magic constant.
+            status = 429 if e.scope == "user_queue_full" else 503
+            raise ApiError(status, str(e), headers={
+                "Retry-After": str(max(1, int(round(e.retry_after_s))))})
+
+    @staticmethod
+    def _apply_deadline(request: web.Request, sampling) -> None:
+        """X-Deadline-Ms header wins over the options/body deadline_ms
+        field; junk values are a client error, not a silent ignore."""
+        hdr = request.headers.get("X-Deadline-Ms")
+        if hdr is None:
+            return
+        try:
+            ms = float(hdr)
+        except ValueError:
+            raise ApiError(400, "X-Deadline-Ms must be a number "
+                                "(milliseconds from arrival)")
+        if ms <= 0:
+            raise ApiError(400, "X-Deadline-Ms must be > 0")
+        sampling.deadline_ms = ms
 
     def _tokenize(self, model: str, text: str, add_bos: bool = True):
         rt = self.engine.resolve_runtime(model)
@@ -217,6 +243,13 @@ class Server:
                 if item is None:
                     remaining = deadline - loop.time()
                     if remaining <= 0:
+                        # Cancel ENGINE-side too, directly on the request:
+                        # engine.cancel alone resolves through req_id,
+                        # which a preemption/retry requeue may have just
+                        # rotated — without the direct flag the slot and
+                        # its KV pages stay held until the generation ends
+                        # on its own.
+                        req.cancelled.set()
                         self.engine.cancel(req.req_id)
                         yield StreamItem("error", error="request timeout")
                         return
@@ -237,6 +270,24 @@ class Server:
         if item.finish_reason == FinishReason.LENGTH:
             return "length"
         return "stop"
+
+    @staticmethod
+    def _error_reason(item: StreamItem) -> str:
+        """done_reason for an error item: degradation terminals keep
+        their DISTINCT reason (kv_exhausted / deadline) — a client must
+        be able to tell honest resource exhaustion from a generic
+        engine error."""
+        if item.finish_reason is not None:
+            return item.finish_reason.value
+        return "error"
+
+    @staticmethod
+    def _error_status(item: StreamItem) -> int:
+        """HTTP status for a non-streaming error item: an expired
+        deadline is a timeout, not an internal error."""
+        if item.finish_reason == FinishReason.DEADLINE:
+            return 504
+        return 500
 
     @staticmethod
     def _gen_stats(req: Request) -> dict:
@@ -521,6 +572,7 @@ class Server:
         sampling = SamplingParams.from_ollama_options(
             body.get("options"), self.engine.ecfg.max_new_tokens
         )
+        self._apply_deadline(request, sampling)
         # `images` accepted for wire-compat (multimodal payloads flow
         # through the queue like test_dispatcher.sh's 5% image traffic);
         # no vision path exists, so the response SAYS so (a `warnings`
@@ -546,6 +598,7 @@ class Server:
         sampling = SamplingParams.from_ollama_options(
             body.get("options"), self.engine.ecfg.max_new_tokens
         )
+        self._apply_deadline(request, sampling)
         chat_cfg = entry.config if entry else get_model_config(model)
         prompt = render_chat(messages, chat_cfg)
         # Templates that emit their own BOS (or define none) must not get a
@@ -565,7 +618,8 @@ class Server:
     def _ollama_final_response(self, request, model, req, items, chat: bool):
         err = next((i for i in items if i.kind == "error"), None)
         if err is not None:
-            raise ApiError(500, f"engine error: {err.error}")
+            raise ApiError(self._error_status(err),
+                           f"engine error: {err.error}")
         text = "".join(i.text for i in items if i.kind == "token")
         done = items[-1]
         payload = {
@@ -603,7 +657,8 @@ class Server:
                 elif item.kind == "error":
                     await resp.write((json.dumps(
                         {"model": model, "created_at": _now_iso(),
-                         "done": True, "done_reason": "error",
+                         "done": True,
+                         "done_reason": self._error_reason(item),
                          "error": item.error}) + "\n").encode())
                     break
                 elif item.kind == "done":
@@ -790,6 +845,7 @@ class Server:
         messages = body.get("messages", [])
         stream = body.get("stream", False)
         sampling = SamplingParams.from_openai(body, self.engine.ecfg.max_new_tokens)
+        self._apply_deadline(request, sampling)
         chat_cfg = entry.config if entry else get_model_config(model)
         prompt = render_chat(messages, chat_cfg)
         # Templates that emit their own BOS (or define none) must not get a
@@ -820,6 +876,7 @@ class Server:
             prompts = [""]
         stream = body.get("stream", False)
         sampling = SamplingParams.from_openai(body, self.engine.ecfg.max_new_tokens)
+        self._apply_deadline(request, sampling)
         rid = f"cmpl-{uuid.uuid4().hex[:24]}"
         if stream:
             if len(prompts) > 1:
@@ -839,7 +896,8 @@ class Server:
             items = await self._collect(req)
             err = next((it for it in items if it.kind == "error"), None)
             if err is not None:
-                raise ApiError(500, f"engine error: {err.error}")
+                raise ApiError(self._error_status(err),
+                               f"engine error: {err.error}")
             text = "".join(it.text for it in items if it.kind == "token")
             choices.append({"index": i, "text": text,
                             "finish_reason": self._done_reason(items[-1])})
@@ -855,7 +913,8 @@ class Server:
     def _openai_final(self, model, req, items, rid, chat: bool):
         err = next((i for i in items if i.kind == "error"), None)
         if err is not None:
-            raise ApiError(500, f"engine error: {err.error}")
+            raise ApiError(self._error_status(err),
+                           f"engine error: {err.error}")
         text = "".join(i.text for i in items if i.kind == "token")
         done = items[-1]
         choice = {"index": 0, "finish_reason": self._done_reason(done)}
@@ -912,7 +971,10 @@ class Server:
                                               "finish_reason": None}))
                 elif item.kind == "error":
                     await resp.write(
-                        ("data: " + json.dumps({"error": item.error}) + "\n\n").encode()
+                        ("data: " + json.dumps(
+                            {"error": item.error,
+                             "reason": self._error_reason(item)}) +
+                         "\n\n").encode()
                     )
                     break
                 elif item.kind == "done":

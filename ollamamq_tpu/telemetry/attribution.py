@@ -52,9 +52,17 @@ EVENT_PHASE = {
     "decode": "decode",
     "stream_stall": "stream",
     "stream_resume": "decode",
+    # Graceful degradation: a preempted request heads back to the queue
+    # (its recompute wait is queue time), a retry waits out its backoff
+    # in the queue, and a page-starved slot holding its reservation is
+    # still inside generation.
+    "preempt": "queue",
+    "retry": "queue",
+    "kv_stall": "decode",
 }
 
-TERMINAL_EVENTS = ("stop", "length", "cancelled", "error")
+TERMINAL_EVENTS = ("stop", "length", "cancelled", "error",
+                   "kv_exhausted", "deadline")
 
 
 def phase_of(event_name: str) -> str:

@@ -102,6 +102,36 @@ PREFIX_CACHE_PAGES = REGISTRY.gauge(
     "KV pages currently owned by the prefix-cache radix tree",
     labels=("model",))
 
+# -- graceful degradation under load (engine preemption / bounded
+# admission / deadlines / retry containment) -------------------------------
+# Closed vocabulary for ollamamq_shed_total{reason}; the doc gate
+# (scripts/check_metrics_docs.py) pins the README table to this tuple.
+SHED_REASONS = ("queue_full", "user_queue_full", "deadline", "kv_exhausted")
+PREEMPTIONS_TOTAL = REGISTRY.counter(
+    "ollamamq_preemptions_total",
+    "Decode slots preempted under KV-pool pressure (victim requeued to "
+    "the front of its user's queue for recompute)", labels=("model",))
+SHED_TOTAL = REGISTRY.counter(
+    "ollamamq_shed_total",
+    "Requests shed instead of served, by reason (queue_full / "
+    "user_queue_full / deadline / kv_exhausted)", labels=("reason",))
+RETRIES_TOTAL = REGISTRY.counter(
+    "ollamamq_retries_total",
+    "Requests re-dispatched after a contained runtime-step failure "
+    "(once each with backoff; repeat offenders are poisoned and errored)",
+    labels=("model",))
+DEADLINE_DROPS_TOTAL = REGISTRY.counter(
+    "ollamamq_deadline_drops_total",
+    "Requests dropped because their per-request deadline expired "
+    "(at admission, before prefill dispatch, or at preemption "
+    "re-admission)", labels=("model",))
+
+
+def total_shed() -> float:
+    """Sum of ollamamq_shed_total over all reasons (TUI chip)."""
+    return sum(child.value for _, child in SHED_TOTAL.series())
+
+
 # -- latency attribution / SLO / alerting (telemetry/attribution.py,
 # telemetry/slo.py, engine/health.py watchdog) ------------------------------
 REQUEST_PHASE_MS = REGISTRY.histogram(

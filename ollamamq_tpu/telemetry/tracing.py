@@ -72,6 +72,9 @@ class Tracer:
         self._ring: collections.deque = collections.deque(maxlen=max(1, capacity))
         self._live: Dict[int, Trace] = {}
         self.epoch = time.monotonic()
+        # Monotonic finish instants of recent requests: the observed
+        # completion rate behind load-shedding Retry-After estimates.
+        self.finish_times: collections.deque = collections.deque(maxlen=256)
 
     def begin(self, req_id: int, user: str, model: str,
               kind: str = "generate") -> Trace:
@@ -86,6 +89,7 @@ class Tracer:
         with self._lock:
             self._live.pop(id(tr), None)
             self._ring.append(tr)
+            self.finish_times.append(time.monotonic())
         tm.REQUESTS_INFLIGHT.dec()
         tm.REQUESTS_TOTAL.labels(model=tr.model or "?", outcome=outcome).inc()
         # Latency attribution: fold the finished timeline's per-phase

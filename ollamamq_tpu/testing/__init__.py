@@ -1,0 +1,3 @@
+"""Deterministic test instrumentation (fault injection) — importable by
+the engine at serving time, not only by the test suite: `--fault-plan`
+wires a plan into the live dispatch seams for chaos benching."""

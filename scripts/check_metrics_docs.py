@@ -8,7 +8,11 @@
      (telemetry/attribution.py PHASES) must appear in the README phase
      table (between the `<!-- phases:begin -->` / `<!-- phases:end -->`
      markers), and the table must not document phases that no longer
-     exist.
+     exist;
+  3. shed reasons — the closed `ollamamq_shed_total{reason}` label
+     vocabulary (telemetry/schema.py SHED_REASONS) must match the README
+     shed-reason table (between the `<!-- shed-reasons:begin -->` /
+     `<!-- shed-reasons:end -->` markers) exactly.
 
 Imports ONLY ollamamq_tpu.telemetry.schema and .attribution — the
 declaration sites — so the check runs without jax, a device, or an
@@ -28,6 +32,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 PHASES_BEGIN = "<!-- phases:begin -->"
 PHASES_END = "<!-- phases:end -->"
+SHED_BEGIN = "<!-- shed-reasons:begin -->"
+SHED_END = "<!-- shed-reasons:end -->"
 
 
 def documented_metric_names(readme_text: str) -> set:
@@ -61,6 +67,22 @@ def registered_phase_names() -> set:
     from ollamamq_tpu.telemetry.attribution import PHASES
 
     return set(PHASES)
+
+
+def documented_shed_reasons(readme_text: str) -> set:
+    """Backticked names inside the marked shed-reason region."""
+    start = readme_text.find(SHED_BEGIN)
+    end = readme_text.find(SHED_END)
+    if start == -1 or end == -1 or end < start:
+        return set()
+    return set(re.findall(r"`([a-z_]+)`", readme_text[start:end]))
+
+
+def registered_shed_reasons() -> set:
+    sys.path.insert(0, _REPO)
+    from ollamamq_tpu.telemetry.schema import SHED_REASONS
+
+    return set(SHED_REASONS)
 
 
 def _diff(readme: str, what: str, registered: set, documented: set,
@@ -100,9 +122,17 @@ def main(argv) -> int:
         "attribution phase(s) missing from the README phase table "
         f"(between {PHASES_BEGIN} / {PHASES_END})",
         "documented phase(s) the attribution layer no longer emits")
+    rc |= _diff(
+        readme, "shed reasons", registered_shed_reasons(),
+        documented_shed_reasons(text),
+        "shed reason(s) missing from the README shed-reason table "
+        f"(between {SHED_BEGIN} / {SHED_END})",
+        "documented shed reason(s) the engine no longer emits")
     if rc == 0:
-        print(f"ok: {len(registered_metric_names())} metrics and "
-              f"{len(registered_phase_names())} phases, all documented")
+        print(f"ok: {len(registered_metric_names())} metrics, "
+              f"{len(registered_phase_names())} phases, and "
+              f"{len(registered_shed_reasons())} shed reasons, "
+              "all documented")
     return rc
 
 
