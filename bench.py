@@ -640,6 +640,13 @@ def _overload_scenario(rt, core, args, rng, touch):
         {"site": "prefill", "kind": "exception", "at": [4]},
     ], seed=7)
     rt.fault_plan = plan
+    # Flight recorder on: the chaos run becomes a checked artifact —
+    # batch occupancy / padding waste read off the journal, and the
+    # invariant checker must stay clean under injected pressure.
+    from ollamamq_tpu.telemetry.journal import (Journal, batch_stats,
+                                                check_invariants)
+    journal = Journal(capacity=65536)
+    rt.journal = journal
 
     recompute = {"tokens": 0}
     preempt0, retries0 = rt.preempt_count, rt.retry_count
@@ -739,9 +746,13 @@ def _overload_scenario(rt, core, args, rng, touch):
 
     ttfts.sort()
     served = len(ttfts)
+    rt.journal = None  # detach before later scenarios reuse this runtime
+    jrecs = journal.tail(None)
     return {
         "requests": n_total,
         "queue_cap": qcap,
+        "journal": batch_stats(jrecs),
+        "invariant_violations": len(check_invariants(jrecs)),
         "elapsed_s": round(elapsed_s, 3),
         "shed": int(shed_count() - shed0),
         "shed_at_admission": shed_at_admission,
@@ -779,10 +790,16 @@ def _slo_burst_scenario(rt, core, args, rng, touch):
     from ollamamq_tpu.telemetry.slo import AlertManager, SLOEngine
     from ollamamq_tpu.telemetry.tracing import Tracer
 
+    from ollamamq_tpu.telemetry.journal import Journal, batch_stats
+
     target = 0.99
     tracer = Tracer(capacity=args.slo_burst * args.slo_burst_size + 8)
     slo = SLOEngine(AlertManager(), ttft_ms=args.slo_ttft_ms, target=target)
     hi = min(rt.cfg.vocab_size, 30000)
+    # Journal the bursts: batch occupancy and padding waste per burst
+    # land in the BENCH record (how much of each padded prefill forward
+    # was real work).
+    journal = Journal(capacity=16384)
 
     def drain():
         for s, r in enumerate(rt.slot_req):
@@ -817,11 +834,13 @@ def _slo_burst_scenario(rt, core, args, rng, touch):
 
     drain()
     run_burst(0, record=False)  # warmup: compiles the B=MAX batch jit
+    rt.journal = journal  # after warmup: stats cover recorded bursts only
     ttfts = []
     t0 = time.monotonic()
     for b in range(args.slo_burst):
         ttfts.extend(run_burst((b + 1) * 1000, record=True))
     elapsed_s = time.monotonic() - t0
+    rt.journal = None
 
     # Attribution breakdown: mean per-phase ms over the recorded bursts'
     # finished traces (warmup requests excluded by req_id).
@@ -846,6 +865,7 @@ def _slo_burst_scenario(rt, core, args, rng, touch):
         "violation_ratio": round(violations / max(1, len(ttfts)), 4),
         # Burn over a window covering the whole run: ratio_bad / budget.
         "burn_rate": round(obj.burn_rate(max(60.0, elapsed_s + 5)), 2),
+        "journal": batch_stats(journal.tail(None)),
         "attribution_ms": {
             p: round(phase_sums[p] / max(1, n_traces), 2)
             for p in attribution.PHASES if p in phase_sums

@@ -252,7 +252,8 @@ struct Tui {
     // ---- three columns: chips/models | users | queues ----
     int col1 = cols * 35 / 100, col2 = cols * 35 / 100;
     int col3 = cols - col1 - col2 - 2;
-    int body = rows - 2 /*bars*/ - 6 /*blocked + headers*/ - 3 /*alerts*/;
+    int body = rows - 2 /*bars*/ - 6 /*blocked + headers*/ - 3 /*alerts*/
+               - 1 /*last-decision line*/;
     if (body < 4) body = 4;
 
     std::vector<std::string> c1 = render_models(stats, col1, body);
@@ -267,6 +268,15 @@ struct Tui {
       l += pad_visible(i < (int)c3.size() ? c3[i] : "", col3);
       line(l, cols);
     }
+
+    // ---- flight recorder: newest scheduler decision, full width (the
+    // explain() one-liner from the engine's decision journal; fixed one
+    // row so the layout never jumps) ----
+    auto last = stats->get("last_decision");
+    if (last && last->type == mj::Value::STR && !last->str.empty())
+      line(std::string(DIM) + " last: " + last->str + RST, cols);
+    else
+      line(std::string(DIM) + " last: (no decisions yet)" + RST, cols);
 
     // ---- alerts (SLO burn-rate + stall watchdog, via the engine's
     // shared alert table; ok when quiet, red rows when firing) ----

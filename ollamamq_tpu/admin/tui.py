@@ -73,6 +73,16 @@ def _engine_stats_brief(engine) -> dict:
     # KV-pressure preemptions across runtimes.
     shed = sum(getattr(engine, "shed_counts", {}).values())
     preempt = sum(m.get("preemptions", 0) or 0 for m in models)
+    # Flight-recorder last-decision line: the newest scheduler decision
+    # (admit/shed/preempt/...) with the inputs that justified it — the
+    # operator's at-a-glance "what did the scheduler just do".
+    last_decision = ""
+    jr = getattr(engine, "journal", None)
+    if jr is not None:
+        try:
+            last_decision = jr.last_summary()
+        except Exception:
+            last_decision = ""
     return {
         "models": models,
         "device": _hbm_cache["device"] or "no-device",
@@ -81,6 +91,7 @@ def _engine_stats_brief(engine) -> dict:
         "hbm_total": _hbm_cache["total"],
         "shed": shed,
         "preempt": preempt,
+        "last_decision": last_decision,
         "alerts": alerts,
     }
 

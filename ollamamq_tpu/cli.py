@@ -115,6 +115,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "(one object per line, request-scoped lines carry "
                         "req_id). Default: ollamamq.log in CWD when the "
                         "TUI owns the terminal, stdout otherwise")
+    p.add_argument("--log-rotate-mb", type=float, default=64.0,
+                   help="rotate --log-file when it reaches this size "
+                        "(MB); 0 disables rotation")
+    p.add_argument("--log-keep", type=int, default=3,
+                   help="rotated --log-file generations kept "
+                        "(file.1 .. file.N)")
+    p.add_argument("--journal-ring", type=int, default=2048,
+                   help="scheduler decision-journal records kept for "
+                        "GET /debug/journal (the engine flight recorder)")
+    p.add_argument("--journal-file", default=os.environ.get(
+                       "OLLAMAMQ_JOURNAL_FILE", ""),
+                   help="spill every decision-journal record to this "
+                        "JSONL file (analyze/replay offline with "
+                        "`python -m ollamamq_tpu.tools.journal`)")
+    p.add_argument("--journal-rotate-mb", type=float, default=64.0,
+                   help="rotate --journal-file at this size (MB); "
+                        "0 disables rotation")
+    p.add_argument("--journal-keep", type=int, default=3,
+                   help="rotated --journal-file generations kept")
     p.add_argument("--metrics-buckets", default="",
                    help="comma-separated upper bounds (ms) for the latency "
                         "histograms on /metrics (ttft/tpot/step/prefill); "
@@ -160,15 +179,25 @@ class JsonLineFormatter(logging.Formatter):
         return json.dumps(out, ensure_ascii=False)
 
 
-def setup_logging(use_tui: bool, log_file: str = "") -> None:
+def setup_logging(use_tui: bool, log_file: str = "",
+                  rotate_mb: float = 64.0, keep: int = 3) -> None:
     """File logging (JSON lines) when --log-file names a path, or — TUI
     owning the terminal with no explicit path — the reference's
-    ollamamq.log default; human-readable stdout otherwise."""
+    ollamamq.log default; human-readable stdout otherwise. File logs
+    rotate at --log-rotate-mb keeping --log-keep generations, so a
+    long soak run cannot fill the disk."""
     level = os.environ.get("OLLAMAMQ_LOG", "INFO").upper()
     if not log_file and use_tui:
         log_file = "ollamamq.log"  # reference default (main.rs:66-87)
     if log_file:
-        handler: logging.Handler = logging.FileHandler(log_file)
+        if rotate_mb and rotate_mb > 0:
+            from logging.handlers import RotatingFileHandler
+
+            handler: logging.Handler = RotatingFileHandler(
+                log_file, maxBytes=int(rotate_mb * 1e6),
+                backupCount=max(1, keep))
+        else:
+            handler = logging.FileHandler(log_file)
         handler.setFormatter(JsonLineFormatter())
     else:
         handler = logging.StreamHandler(sys.stdout)
@@ -182,7 +211,8 @@ def setup_logging(use_tui: bool, log_file: str = "") -> None:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     use_tui = not args.no_tui and sys.stdout.isatty()
-    setup_logging(use_tui, log_file=args.log_file)
+    setup_logging(use_tui, log_file=args.log_file,
+                  rotate_mb=args.log_rotate_mb, keep=args.log_keep)
     log = logging.getLogger("ollamamq")
     if not (0.0 < args.slo_target < 1.0):
         log.error("--slo-target must be in (0, 1), got %s", args.slo_target)
@@ -191,6 +221,14 @@ def main(argv=None) -> int:
             or args.preempt_max < 0:
         log.error("--max-queued / --max-queued-per-user / --preempt-max "
                   "must be >= 0")
+        return 2
+    if args.journal_ring < 1 or args.journal_keep < 1 or args.log_keep < 1:
+        log.error("--journal-ring / --journal-keep / --log-keep "
+                  "must be >= 1")
+        return 2
+    if args.journal_rotate_mb < 0 or args.log_rotate_mb < 0:
+        log.error("--journal-rotate-mb / --log-rotate-mb must be >= 0 "
+                  "(0 disables rotation)")
         return 2
     if args.fault_plan:
         # Schema-check the plan BEFORE any engine/device work: a typo'd
@@ -270,6 +308,10 @@ def main(argv=None) -> int:
         max_queued=args.max_queued,
         max_queued_per_user=args.max_queued_per_user,
         fault_plan=args.fault_plan or None,
+        journal_ring=args.journal_ring,
+        journal_file=args.journal_file or None,
+        journal_rotate_mb=args.journal_rotate_mb,
+        journal_keep=args.journal_keep,
     )
     fairness = Fairness.TOKENS if args.token_fairness else Fairness.REQUESTS
 

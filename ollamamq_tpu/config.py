@@ -278,6 +278,16 @@ class EngineConfig:
     # Deterministic fault injection (testing/faults.py): path to a plan
     # file, or a FaultPlan instance (tests). None = no injection.
     fault_plan: Optional[object] = None
+    # -- flight recorder (telemetry/journal.py) ------------------------------
+    # Decision-journal ring capacity (records retained for /debug/journal
+    # and the health monitor's invariant sweep).
+    journal_ring: int = 2048
+    # Optional JSONL spill of every journal record (--journal-file);
+    # rotated at journal_rotate_mb, keeping journal_keep rotated files —
+    # bounded disk on soak runs.
+    journal_file: Optional[str] = None
+    journal_rotate_mb: float = 64.0
+    journal_keep: int = 3
 
     @property
     def max_context(self) -> int:

@@ -53,6 +53,7 @@ from ollamamq_tpu.parallel.mesh import (make_mesh, replica_submesh,
 from ollamamq_tpu.parallel.sharding import kv_cache_spec, shard_params
 from ollamamq_tpu.telemetry import mfu as mfu_model
 from ollamamq_tpu.telemetry import schema as tm
+from ollamamq_tpu.telemetry.journal import Journal
 from ollamamq_tpu.telemetry.slo import AlertManager, SLOEngine
 from ollamamq_tpu.telemetry.tracing import DECODE_EVENT_EVERY, Tracer
 
@@ -77,13 +78,21 @@ def sweep_blocked(core: MQCore, held_fn, last_version: int) -> int:
     return ver
 
 
-def drop_expired(req: Request, core: MQCore, model: str) -> None:
+def drop_expired(req: Request, core: MQCore, model: str,
+                 journal=None) -> None:
     """Finish an expired request with the explicit deadline reason and
     count the shed — expired queued work is dropped without burning a
-    single TPU cycle on it, and the client learns WHY."""
+    single TPU cycle on it, and the client learns WHY. The journal
+    record carries the slack (how long past the deadline the drop
+    happened), the input that justifies the decision."""
     core.mark_dropped(req.user, started=getattr(req, "started", True))
     tm.DEADLINE_DROPS_TOTAL.labels(model=model or "?").inc()
     tm.SHED_TOTAL.labels(reason="deadline").inc()
+    if journal is not None:
+        slack = ((time.monotonic() - req.deadline) * 1e3
+                 if req.deadline is not None else 0.0)
+        journal.record("deadline_drop", req=req, model=model or None,
+                       slack_ms=round(slack, 3))
     req.finish(FinishReason.DEADLINE,
                error="deadline expired before completion")
 
@@ -157,6 +166,13 @@ def serve_embed_batch(rt, core: "MQCore", pending, max_len: int,
     On a dispatch failure the batch's requests are errored BEFORE the
     exception propagates — a popped request must never be left hanging
     (it is in no queue _fail_runtime can see)."""
+    journal = getattr(rt, "journal", None)
+
+    def jfinish(req: Request, reason: str) -> None:
+        if journal is not None:
+            journal.record("finish", req=req, model=rt.name, reason=reason,
+                           tokens=len(req.prompt_tokens))
+
     batch: List[Request] = []
     while pending and len(batch) < max_batch:
         if pending[0]._retry_at > time.monotonic():
@@ -164,11 +180,12 @@ def serve_embed_batch(rt, core: "MQCore", pending, max_len: int,
         req = pending.popleft()
         if req.cancelled.is_set():
             core.mark_dropped(req.user)
+            jfinish(req, "cancelled")
             req.finish(FinishReason.CANCELLED)
             continue
         if req.expired():
             # Expired queued embeds are dropped before the batch forward.
-            drop_expired(req, core, rt.name)
+            drop_expired(req, core, rt.name, journal=journal)
             continue
         n = len(req.prompt_tokens)
         if n > max_len:
@@ -176,6 +193,7 @@ def serve_embed_batch(rt, core: "MQCore", pending, max_len: int,
             # pending request of this runtime (cross-user blast radius,
             # ADVICE r1).
             core.mark_dropped(req.user)
+            jfinish(req, "error")
             req.finish(FinishReason.ERROR,
                        error=f"input length {n} exceeds maximum {max_len}")
             continue
@@ -213,6 +231,7 @@ def serve_embed_batch(rt, core: "MQCore", pending, max_len: int,
             core.mark_dropped(r.user)
             poison = getattr(rt, "_poison_msg", None)
             msg = f"embed failed: {e}"
+            jfinish(r, "error")
             r.finish(FinishReason.ERROR,
                      error=poison(r, msg) if poison else msg)
         raise
@@ -224,6 +243,7 @@ def serve_embed_batch(rt, core: "MQCore", pending, max_len: int,
         # TUI tok/s telemetry.
         rt.tokens_generated += int(lens[i])
         core.mark_done(r.user, tokens=int(lens[i]))
+        jfinish(r, "stop")
         r.finish(FinishReason.STOP)
     return True
 
@@ -253,6 +273,11 @@ class ModelRuntime:
     # engine when --fault-plan is set. Shared across a process's runtimes
     # so the plan's call counters form one deterministic stream.
     fault_plan = None
+
+    # Decision journal (telemetry/journal.py), attached by the owning
+    # engine's _attach_hooks. None on SPMD worker hosts' replay runtimes —
+    # journaling, like SLO accounting, is primary-only.
+    journal = None
 
     def __init__(
         self,
@@ -555,6 +580,21 @@ class ModelRuntime:
         retry/containment paths exist for."""
         if self.fault_plan is not None:
             self.fault_plan.check(site)
+
+    # -- decision journal seams --------------------------------------------
+    def _jrec(self, kind: str, req=None, **fields) -> None:
+        """Journal one decision with this runtime's model name; no-op
+        when no journal is attached (SPMD workers, bare unit tests)."""
+        j = self.journal
+        if j is not None:
+            j.record(kind, req=req, model=self.name, **fields)
+
+    def _page_state(self) -> dict:
+        """Allocator post-state for page events: the inputs the
+        pages-conserved invariant (free+used+cached==pool) checks."""
+        a = self.alloc
+        return {"free": a.free_pages, "used": a.used_pages,
+                "cached": a.cached_pages, "pool": a.num_pages - 1}
 
     # -- dispatch seams (SPMD subclass broadcasts before dispatching) ------
     # Each returns (sampled_tokens, kc', vc', recent'); the caller assigns
@@ -905,6 +945,8 @@ class ModelRuntime:
         req = self.slot_req[slot]
         if req is None:
             return
+        self._jrec("finish", req, reason=reason.value, slot=slot,
+                   tokens=len(req.generated_ids))
         # Pass req: an installed slot's prompt KV is fully written, so
         # its full prompt pages are insertable into the prefix cache.
         self._release_slot_pages(slot, req)
@@ -994,6 +1036,7 @@ class ModelRuntime:
             if req.cancelled.is_set():
                 self.pending_prefill.popleft()
                 core.mark_dropped(req.user)
+                self._jrec("finish", req, reason="cancelled")
                 req.finish(FinishReason.CANCELLED)
                 continue
             if req._retry_at > time.monotonic():
@@ -1002,7 +1045,7 @@ class ModelRuntime:
                 # Deadline check BEFORE the prefill dispatch: expired
                 # queued work is dropped without burning TPU time.
                 self.pending_prefill.popleft()
-                drop_expired(req, core, self.name)
+                drop_expired(req, core, self.name, journal=self.journal)
                 continue
             n = len(req.prompt_tokens)
             # Prompts beyond the largest bucket stream through chunked
@@ -1011,6 +1054,7 @@ class ModelRuntime:
             if n > max_prompt:
                 self.pending_prefill.popleft()
                 core.mark_dropped(req.user)  # mark_started ran at admission
+                self._jrec("finish", req, reason="error")
                 req.finish(
                     FinishReason.ERROR,
                     error=f"prompt length {n} exceeds maximum {max_prompt}",
@@ -1154,6 +1198,19 @@ class ModelRuntime:
         self.inflight_prefill = [req for req, *_ in batch]
         for req, _, _, n in batch:
             req.trace_event("prefill", bucket=bucket, tokens=n)
+        # Batch-compose decision record: who shares this forward, the
+        # padded shape it pays for, and the occupancy/backlog inputs the
+        # composition saw — the offline analyzer's padding-waste and
+        # occupancy stats read straight off these.
+        self._jrec("batch",
+                   slots=[slot for _, slot, _, _ in batch],
+                   reqs=[req.req_id for req, *_ in batch],
+                   bucket=bucket, batch_size=B,
+                   tokens=int(sum(n for *_, n in batch)),
+                   occupancy=round(self.active_count()
+                                   / max(1, self.ecfg.max_slots), 4),
+                   pending=len(self.pending_prefill),
+                   free_pages=self.alloc.free_pages)
         t0 = time.monotonic()
         try:
             toks, self.kc, self.vc, self.recent = self._dispatch_prefill(
@@ -1216,8 +1273,13 @@ class ModelRuntime:
         pages = self.alloc.alloc(num_tokens)
         if pages is None and self.prefix_cache is not None:
             short = self.alloc.pages_needed(num_tokens) - self.alloc.free_pages
-            if short > 0 and self.prefix_cache.evict(short) > 0:
-                pages = self.alloc.alloc(num_tokens)
+            if short > 0:
+                freed = self.prefix_cache.evict(short)
+                if freed > 0:
+                    self._jrec("page_evict", n=freed, **self._page_state())
+                    pages = self.alloc.alloc(num_tokens)
+        if pages is not None:
+            self._jrec("page_alloc", n=len(pages), **self._page_state())
         return pages
 
     def _alloc_tail(self, held: int, num_tokens: int) -> Optional[List[int]]:
@@ -1227,23 +1289,38 @@ class ModelRuntime:
         pages = self.alloc.alloc_n(need, held=held)
         if pages is None and self.prefix_cache is not None:
             short = need - self.alloc.free_pages
-            if short > 0 and self.prefix_cache.evict(short) > 0:
-                pages = self.alloc.alloc_n(need, held=held)
+            if short > 0:
+                freed = self.prefix_cache.evict(short)
+                if freed > 0:
+                    self._jrec("page_evict", n=freed, **self._page_state())
+                    pages = self.alloc.alloc_n(need, held=held)
+        if pages is not None:
+            self._jrec("page_alloc", n=len(pages), **self._page_state())
         return pages
 
     def _extend_pages(self, pages: List[int], new_total_tokens: int) -> bool:
         """Decode-time page growth with the eviction backstop."""
         if self.fault_plan is not None and self.fault_plan.blocked("extend"):
             return False  # injected allocation pressure
+        before = len(pages)
         if self.alloc.extend(pages, new_total_tokens):
+            if len(pages) > before:
+                self._jrec("page_alloc", n=len(pages) - before,
+                           **self._page_state())
             return True
         if self.prefix_cache is None:
             return False
         need = self.alloc.pages_needed(new_total_tokens) - len(pages)
         if need <= 0 or len(pages) + need > self.alloc.max_pages_per_seq:
             return False  # per-seq cap: eviction can't help
-        if self.prefix_cache.evict(need - self.alloc.free_pages) > 0:
-            return self.alloc.extend(pages, new_total_tokens)
+        freed = self.prefix_cache.evict(need - self.alloc.free_pages)
+        if freed > 0:
+            self._jrec("page_evict", n=freed, **self._page_state())
+            if self.alloc.extend(pages, new_total_tokens):
+                if len(pages) > before:
+                    self._jrec("page_alloc", n=len(pages) - before,
+                               **self._page_state())
+                return True
         return False
 
     def _release_slot_pages(self, slot: int,
@@ -1259,7 +1336,11 @@ class ModelRuntime:
         pages = self.slot_pages[slot]
         pc = self.prefix_cache
         if pc is None:
+            n_freed = len(pages)
             self.alloc.free(pages)
+            if n_freed:
+                self._jrec("page_free", n=n_freed, slot=slot,
+                           **self._page_state())
         else:
             pins = self.slot_pins[slot]
             keep = len(pins)  # shared tree pages lead slot_pages
@@ -1269,16 +1350,21 @@ class ModelRuntime:
                 if full > keep:
                     pc.insert(req.prompt_tokens, pages[:full])
                     keep = full
+            n_freed = len(pages) - keep
             self.alloc.free(pages[keep:])
             pc.release(pins)
             self.slot_pages[slot] = []
             self.slot_pins[slot] = []
+            if n_freed > 0:
+                self._jrec("page_free", n=n_freed, slot=slot,
+                           **self._page_state())
         self.page_table[slot, :] = kvc.TRASH_PAGE
 
     def _install_slot(self, slot: int, req: Request, n: int, tok: int,
                       core: MQCore) -> None:
         """Activate a freshly prefilled request in its decode slot and emit
         the first sampled token."""
+        self._jrec("install", req, slot=slot, n_prompt=n)
         self.slot_req[slot] = req
         self._tm_prompt_tokens.inc(n)
         self.seq_lens[slot] = n
@@ -1353,6 +1439,21 @@ class ModelRuntime:
         written = len(replay) - 1 if req.generated_ids else len(replay)
         req.trace_event("preempt", slot=slot, tokens=written,
                         n=req.preemptions)
+        if self.journal is not None:
+            # Decision inputs: pool pressure plus the victim's fair-share
+            # standing (most-served user loses) and the VIP it must never
+            # be — the explainability contract for every preemption.
+            vip, served = None, None
+            try:
+                snap = self.core_snapshot_for_preempt()
+                vip = snap.get("vip")
+                served = snap.get("users", {}).get(req.user, {}).get(
+                    "processed")
+            except Exception:
+                pass
+            self._jrec("preempt", req, slot=slot, why="kv_pressure",
+                       n=req.preemptions, free_pages=self.alloc.free_pages,
+                       victim_served=served, vip=vip)
         req.prompt_tokens = replay[:written]
         self._release_slot_pages(slot, req if written else None)
         req.prompt_tokens = replay
@@ -1391,6 +1492,9 @@ class ModelRuntime:
                     # pages), sit out dispatches until pages free up.
                     self.slot_req[slot].trace_event(
                         "kv_stall", pages=len(pages))
+                    self._jrec("kv_stall", self.slot_req[slot], slot=slot,
+                               free_pages=self.alloc.free_pages,
+                               need=need_tokens)
                     self._stalled_slots.add(slot)
                     return
                 self._preempt_slot(victim, core)
@@ -1400,6 +1504,8 @@ class ModelRuntime:
                     self._stalled_slots.discard(slot)
                     return
             self.slot_req[slot].trace_event("kv_stall", pages=len(pages))
+            self._jrec("kv_stall", self.slot_req[slot], slot=slot,
+                       free_pages=self.alloc.free_pages, need=need_tokens)
             self._stalled_slots.add(slot)
         finally:
             self._preempt_core = None
@@ -1437,6 +1543,7 @@ class ModelRuntime:
         req._retry_at = time.monotonic() + (
             self.ecfg.retry_backoff_s * (2 ** (req.retries - 1)))
         req.trace_event("retry", error=msg[:200], n=req.retries)
+        self._jrec("retry", req, n=req.retries, error=msg[:120])
         queue.appendleft(req)
         return True
 
@@ -1447,6 +1554,7 @@ class ModelRuntime:
         """Error text for a request whose retry budget is spent: the
         client (and the log) must see that retries happened and stopped
         on purpose."""
+        self._jrec("poison", req, retries=req.retries, error=msg[:120])
         if req.retries:
             return (f"{msg} (request poisoned after {req.retries} "
                     f"retr{'y' if req.retries == 1 else 'ies'})")
@@ -1467,6 +1575,7 @@ class ModelRuntime:
             self._release_slot_pages(slot)
             self.reserved_slots.discard(slot)
             core.mark_dropped(req.user)
+            self._jrec("finish", req, reason="cancelled")
             req.finish(FinishReason.CANCELLED)
             return True
         if req.expired():
@@ -1475,7 +1584,7 @@ class ModelRuntime:
             self.chunking.popleft()
             self._release_slot_pages(slot)
             self.reserved_slots.discard(slot)
-            drop_expired(req, core, self.name)
+            drop_expired(req, core, self.name, journal=self.journal)
             return True
 
         s = req.sampling
@@ -1498,6 +1607,8 @@ class ModelRuntime:
             prev = req.prompt_tokens[max(0, chunk_start - W):chunk_start]
             seed_row[0, W - len(prev):] = prev
         req.trace_event("prefill_chunk", pos=chunk_start, tokens=cl)
+        self._jrec("chunk", req, slot=slot, pos=chunk_start, tokens=cl,
+                   cached=base)
         t0 = time.monotonic()
         is_final = 1 if chunk_start + cl >= n else 0
         try:
@@ -1813,6 +1924,7 @@ class EncoderRuntime:
     slo = None  # encoders emit no tokens; attached but never recorded into
     fault_plan = None  # attached by the engine like ModelRuntime's
     on_preempt = None  # encoders hold no KV pages; attached but unused
+    journal = None  # decision journal (the SPMD broadcast seam reads it)
 
     def __init__(self, name, model_cfg, engine_cfg, mesh=None,
                  checkpoint_path=None, dtype=jnp.bfloat16):
@@ -2109,6 +2221,17 @@ class TPUEngine:
                              ttft_ms=engine_cfg.slo_ttft_ms or None,
                              tpot_ms=engine_cfg.slo_tpot_ms or None,
                              target=engine_cfg.slo_target)
+        # Flight recorder: every scheduler decision (admit/shed/batch/
+        # preempt/...) as a typed record in a bounded ring, tailed at
+        # GET /debug/journal and optionally spilled to --journal-file.
+        self.journal = Journal(
+            capacity=engine_cfg.journal_ring,
+            path=engine_cfg.journal_file,
+            rotate_bytes=int(engine_cfg.journal_rotate_mb * 1e6),
+            keep=engine_cfg.journal_keep,
+            meta={"model": engine_cfg.model,
+                  "max_slots": engine_cfg.max_slots,
+                  "num_pages": engine_cfg.num_pages})
         # Engine-loop liveness tick for the stall watchdog: bumped at the
         # top of every _loop_once, so a dispatch wedged inside a step
         # leaves it stale while work is pending.
@@ -2167,9 +2290,11 @@ class TPUEngine:
 
     def _attach_hooks(self, rep) -> None:
         """Primary-side engine hooks on a (re)built runtime: SLO
-        accounting, fault injection, and the preemption requeue path."""
+        accounting, fault injection, decision journaling, and the
+        preemption requeue path."""
         rep.slo = self.slo
         rep.fault_plan = self.fault_plan
+        rep.journal = self.journal
         if self.ecfg.preempt:
             rep.on_preempt = self._requeue_preempted
 
@@ -2205,12 +2330,26 @@ class TPUEngine:
         cfg = self.ecfg
         if cfg.max_queued and self.core.total_queued() >= cfg.max_queued:
             self._count_shed("queue_full")
-            raise QueueFullError("queue_full", self.retry_after_s(),
-                                 cfg.max_queued)
+            retry_s = self.retry_after_s()
+            self.journal.record(
+                "shed", user=user, model=model or None, reason="queue_full",
+                queued=self.core.total_queued(), limit=cfg.max_queued,
+                retry_after_s=round(retry_s, 3),
+                n_prompt=len(prompt_tokens or []),
+                max_tokens=getattr(sampling, "max_tokens", None))
+            raise QueueFullError("queue_full", retry_s, cfg.max_queued)
         if (cfg.max_queued_per_user
                 and self.core.queue_len(user) >= cfg.max_queued_per_user):
             self._count_shed("user_queue_full")
-            raise QueueFullError("user_queue_full", self.retry_after_s(),
+            retry_s = self.retry_after_s()
+            self.journal.record(
+                "shed", user=user, model=model or None,
+                reason="user_queue_full", queued=self.core.queue_len(user),
+                limit=cfg.max_queued_per_user,
+                retry_after_s=round(retry_s, 3),
+                n_prompt=len(prompt_tokens or []),
+                max_tokens=getattr(sampling, "max_tokens", None))
+            raise QueueFullError("user_queue_full", retry_s,
                                  cfg.max_queued_per_user)
         with self._pending_lock:
             rid = self.core.enqueue(
@@ -2221,6 +2360,11 @@ class TPUEngine:
                           kind=kind, raw_prompt=raw_prompt)
             req.trace = self.tracer.begin(rid, user, model, kind=kind)
             self.pending[rid] = req
+        self.journal.record(
+            "enqueue", req=req, n_prompt=len(req.prompt_tokens),
+            queued=self.core.total_queued(), kind_req=kind,
+            max_tokens=req.sampling.max_tokens,
+            deadline_ms=getattr(req.sampling, "deadline_ms", 0.0) or None)
         self.notify()
         return req
 
@@ -2262,7 +2406,11 @@ class TPUEngine:
             if span > 0:
                 rate = (len(window) - 1) / span  # completions per second
                 return float(min(300.0, max(1.0, queued / rate)))
-        return float(min(30.0, max(1.0, queued)))
+        # Cold start: no completions observed yet, so queue depth says
+        # nothing about drain rate — clamp to a small fixed window
+        # instead of extrapolating (a 500-deep startup queue must not
+        # answer "Retry-After: 500 seconds" off zero samples).
+        return float(min(10.0, max(2.0, float(queued))))
 
     def _requeue_preempted(self, req: Request) -> bool:
         """on_preempt hook: return a preempted request to the FRONT of
@@ -2271,12 +2419,13 @@ class TPUEngine:
         finished here — its pages are already released by the caller."""
         if req.cancelled.is_set():
             self.core.mark_dropped(req.user)
+            self.journal.record("finish", req=req, reason="cancelled")
             req.finish(FinishReason.CANCELLED)
             return False
         if req.expired():
             # Deadline check at preemption re-admission: recompute for a
             # response nobody will wait for is pure waste.
-            drop_expired(req, self.core, req.model)
+            drop_expired(req, self.core, req.model, journal=self.journal)
             return False
         try:
             with self._pending_lock:
@@ -2285,10 +2434,12 @@ class TPUEngine:
                 req.req_id = new_rid
                 self.pending[new_rid] = req
             req.trace_event("requeue")
+            self.journal.record("requeue", req=req, why="preempt")
             self.notify()
             return True
         except BlockedError:
             self.core.mark_dropped(req.user)
+            self.journal.record("finish", req=req, reason="cancelled")
             req.finish(FinishReason.CANCELLED)
             return False
 
@@ -2302,13 +2453,16 @@ class TPUEngine:
         started = getattr(req, "started", True)
         if req.cancelled.is_set():
             self.core.mark_dropped(req.user, started=started)
+            self.journal.record("finish", req=req, reason="cancelled")
             req.finish(FinishReason.CANCELLED)
             return
         if req.expired():
-            drop_expired(req, self.core, req.model)
+            drop_expired(req, self.core, req.model, journal=self.journal)
             return
         if req.retries >= self.ecfg.step_retries:
             self.core.mark_dropped(req.user, started=started)
+            self.journal.record("poison", req=req, retries=req.retries,
+                                error=msg[:120])
             req.finish(FinishReason.ERROR, error=(
                 f"{msg} (request poisoned after {req.retries} retr"
                 f"{'y' if req.retries == 1 else 'ies'})"))
@@ -2325,6 +2479,8 @@ class TPUEngine:
                                  + req.generated_ids[req._replay_gen:])
             req._replay_gen = len(req.generated_ids)
         req.trace_event("retry", error=msg[:200], n=req.retries)
+        self.journal.record("retry", req=req, n=req.retries,
+                            error=msg[:120])
         try:
             with self._pending_lock:
                 new_rid = self.core.requeue_front(req.user, "", req.model,
@@ -2334,6 +2490,7 @@ class TPUEngine:
             self.notify()
         except BlockedError:
             self.core.mark_dropped(req.user, started=started)
+            self.journal.record("finish", req=req, reason="cancelled")
             req.finish(FinishReason.CANCELLED)
 
     def cancel(self, req_id: int) -> None:
@@ -2473,6 +2630,7 @@ class TPUEngine:
         if self.health is not None:
             self.health.stop()
             self.health = None
+        self.journal.close()  # flush any --journal-file spill
 
     @staticmethod
     def _gate_eligible(rt, kind: str) -> bool:
@@ -2516,6 +2674,8 @@ class TPUEngine:
                 continue
             self._orphans.remove((rid, user, model, ts))
             req.trace_event("admit")
+            self.journal.record("admit", req=req,
+                                queued=self.core.total_queued())
             if self._place(req, user, model):
                 admitted += 1
         # Age out expiry tombstones nothing ever claimed (slow leak guard).
@@ -2560,6 +2720,8 @@ class TPUEngine:
                 self._orphans.append((rid, user, model, time.monotonic()))
                 continue
             req.trace_event("admit")
+            self.journal.record("admit", req=req,
+                                queued=self.core.total_queued())
             if self._place(req, user, model):
                 admitted += 1
         return admitted
@@ -2569,12 +2731,13 @@ class TPUEngine:
         # blocked after enqueueing ⇒ drop, never serve.
         if req.cancelled.is_set() or self.core.is_user_or_ip_blocked(user):
             self.core.mark_dropped(user, started=req.started)
+            self.journal.record("finish", req=req, reason="cancelled")
             req.finish(FinishReason.CANCELLED)
             return False
         if req.expired():
             # Deadline check at admission: an expired pop is dropped here,
             # before it can claim a slot or a prefill forward.
-            drop_expired(req, self.core, model)
+            drop_expired(req, self.core, model, journal=self.journal)
             return False
         rt = self.resolve_runtime(model, kind=req.kind)
         if rt is None and model:
@@ -2587,6 +2750,7 @@ class TPUEngine:
             return self._requeue(req, user, model)
         if rt is None:
             self.core.mark_dropped(user, started=req.started)
+            self.journal.record("finish", req=req, reason="error")
             req.finish(FinishReason.ERROR, error=f"model not loaded: {model}")
             return False
         # Named-model kind check: generate on an encoder would "finish"
@@ -2597,6 +2761,7 @@ class TPUEngine:
         probe = rt.replicas[0] if isinstance(rt, ReplicaSet) else rt
         if req.kind not in getattr(probe, "SERVES", ("generate",)):
             self.core.mark_dropped(user, started=req.started)
+            self.journal.record("finish", req=req, reason="error")
             req.finish(FinishReason.ERROR, error=(
                 f"model {model or probe.name} is an embedding-only model"
                 if req.kind == "generate"
@@ -2613,6 +2778,8 @@ class TPUEngine:
             # requeue would spin; park on the least-loaded live replica.
             rt.force_submit(req)
         req.trace_event("place", runtime=getattr(rt, "name", model))
+        self.journal.record("place", req=req,
+                            runtime=getattr(rt, "name", model))
         if not req.started:
             # Preempted/retried requeues were already counted as started;
             # a second mark would leak a processing count forever.
@@ -2632,8 +2799,10 @@ class TPUEngine:
                 req.req_id = new_rid
                 self.pending[new_rid] = req
             req.trace_event("requeue")
+            self.journal.record("requeue", req=req, why="unplaceable")
         except BlockedError:
             self.core.mark_dropped(user, started=False)
+            self.journal.record("finish", req=req, reason="cancelled")
             req.finish(FinishReason.CANCELLED)
         return False
 
@@ -2680,6 +2849,7 @@ class TPUEngine:
 
     def _loop_once(self) -> None:
         self.last_tick_at = time.monotonic()
+        self.journal.tick += 1
         self._drain_engine_calls()
         self._swap_rebuilt()
         if (self._failed_runtimes
@@ -2841,6 +3011,7 @@ class TPUEngine:
                     fresh.submit(q.popleft())  # restart from scratch
             self._failed_runtimes.remove(rt)
             self._recovering.discard(id(rt))
+            self.journal.record("rebuild", model=rt.name)
             log.warning("runtime %s recovered: weights reloaded, serving "
                         "resumes", rt.name)
             self.notify()

@@ -29,6 +29,7 @@ class FakeRuntime:
     slo = None  # attached by FakeEngine.load_model, like ModelRuntime
     fault_plan = None  # deterministic fault injection (testing/faults.py)
     on_preempt = None  # attached like ModelRuntime's (unused by fakes)
+    journal = None  # decision journal, attached like ModelRuntime's
 
     def __init__(self, name: str, engine_cfg: EngineConfig,
                  token_latency_s: float = 0.0, is_encoder: bool = False):
@@ -71,11 +72,19 @@ class FakeRuntime:
         self.pending_prefill.append(req)
         return True
 
+    def _jrec(self, kind, req=None, **fields) -> None:
+        # Same journaling seam as ModelRuntime: the fake engine's decision
+        # stream is what the deterministic replay harness re-drives.
+        if self.journal is not None:
+            self.journal.record(kind, req=req, model=self.name, **fields)
+
     def check_cancellations(self, core) -> None:
         for req in list(self.active):
             if req.cancelled.is_set():
                 self.active.remove(req)
                 core.mark_dropped(req.user)
+                self._jrec("finish", req, reason="cancelled",
+                           tokens=len(req.generated_ids))
                 req.finish(FinishReason.CANCELLED)
 
     def step(self, core) -> None:
@@ -91,6 +100,7 @@ class FakeRuntime:
             req = self.pending_prefill.popleft()
             if req.cancelled.is_set():
                 core.mark_dropped(req.user)
+                self._jrec("finish", req, reason="cancelled", tokens=0)
                 req.finish(FinishReason.CANCELLED)
                 continue
             if req.expired():
@@ -98,13 +108,15 @@ class FakeRuntime:
                 # queued work drops before any "compute" is spent.
                 from ollamamq_tpu.engine.engine import drop_expired
 
-                drop_expired(req, core, self.name)
+                drop_expired(req, core, self.name, journal=self.journal)
                 continue
             if self.is_encoder or req.kind == "embed":
                 req.trace_event("embed_batch", tokens=len(req.prompt_tokens))
                 req.embedding = self._fake_embedding(req)
                 req.stats.first_token_at = time.monotonic()
                 core.mark_done(req.user, tokens=len(req.prompt_tokens))
+                self._jrec("finish", req, reason="stop",
+                           tokens=len(req.prompt_tokens))
                 req.finish(FinishReason.STOP)
             else:
                 req.trace_event("prefill", tokens=len(req.prompt_tokens))
@@ -116,6 +128,8 @@ class FakeRuntime:
                 req._fake_remaining = max(
                     1, min(req.sampling.max_tokens, 16) - done)
                 req._fake_idx = done
+                self._jrec("install", req, slot=-1,
+                           n_prompt=len(req.prompt_tokens))
                 self.active.append(req)
         self._tm_occupancy.set(len(self.active) / max(1, self.ecfg.max_slots))
         if self.token_latency_s:
@@ -124,6 +138,8 @@ class FakeRuntime:
             if req.cancelled.is_set():
                 self.active.remove(req)
                 core.mark_dropped(req.user)
+                self._jrec("finish", req, reason="cancelled",
+                           tokens=len(req.generated_ids))
                 req.finish(FinishReason.CANCELLED)
                 continue
             word = f"word{req._fake_idx} "
@@ -147,6 +163,8 @@ class FakeRuntime:
                 self.active.remove(req)
                 core.mark_done(req.user, tokens=len(req.generated_ids))
                 req.stats.completion_tokens = len(req.generated_ids)
+                self._jrec("finish", req, reason="stop",
+                           tokens=len(req.generated_ids))
                 req.finish(FinishReason.STOP)
                 continue
             if chunk:
@@ -158,6 +176,8 @@ class FakeRuntime:
                     req.stream.push(StreamItem("token", text=tail))
                 core.mark_done(req.user, tokens=len(req.generated_ids))
                 req.stats.completion_tokens = len(req.generated_ids)
+                self._jrec("finish", req, reason="length",
+                           tokens=len(req.generated_ids))
                 req.finish(FinishReason.LENGTH)
 
     def _fake_embedding(self, req: Request) -> list:
@@ -212,12 +232,14 @@ class FakeEngine(TPUEngine):
         )
         rt.slo = self.slo
         rt.fault_plan = self.fault_plan
+        rt.journal = self.journal
         self.runtimes[name] = rt
         self.notify()
 
     def _loop(self) -> None:
         while self._running:
             self.last_tick_at = time.monotonic()
+            self.journal.tick += 1
             self._admit()
             did_work = False
             for rt in list(self.runtimes.values()):

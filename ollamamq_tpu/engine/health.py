@@ -232,6 +232,7 @@ class HealthMonitor:
             "snapshots/heartbeats", "worker_host")
 
         self._check_preempt_storm()
+        self._check_journal_invariants()
 
         slo = getattr(self.engine, "slo", None)
         if slo is not None:
@@ -278,6 +279,35 @@ class HealthMonitor:
                 "recompute is eating throughput)", source="watchdog")
         else:
             alerts.resolve("preempt_storm")
+
+    def _check_journal_invariants(self) -> None:
+        """Flight-recorder invariant sweep over the decision-journal ring
+        (telemetry/journal.py check_invariants): pages conserved, no slot
+        double-assignment, preempt victim never the VIP, sheds only over
+        bounds, no starvation. A violation means a scheduler bug is live
+        in production — alert loudly (every chaos/fault-injection run
+        becomes a checked artifact through the same sweep), resolve when
+        the offending records age out of the ring."""
+        alerts = getattr(self.engine, "alerts", None)
+        journal = getattr(self.engine, "journal", None)
+        if alerts is None or journal is None:
+            return
+        from ollamamq_tpu.telemetry.journal import check_invariants
+
+        try:
+            bad = check_invariants(journal.tail(None))
+        except Exception:
+            log.exception("journal invariant sweep failed")
+            return
+        if bad:
+            log.error("scheduler invariant violation(s): %s", "; ".join(
+                bad[:3]))
+            alerts.fire(
+                "journal_invariant", "page",
+                f"{len(bad)} scheduler invariant violation(s) in the "
+                f"decision journal; first: {bad[0]}", source="watchdog")
+        else:
+            alerts.resolve("journal_invariant")
 
     def status(self) -> dict:
         alerts = getattr(self.engine, "alerts", None)

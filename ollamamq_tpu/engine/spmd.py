@@ -518,6 +518,12 @@ def _mirrored_dispatch(rt, op, a, b, values, dispatch):
         # post-broadcast failure is real KV divergence, which is the
         # desync path's job, not injection's.
         rt.fault_plan.check(_OP_SITE.get(op, "decode"))
+    if rt.journal is not None:
+        # Primary-host journaling of the broadcast plan: workers replay
+        # this exact wire sequence, so a desync postmortem can line the
+        # journal's wire_seq up against each host's replay position.
+        rt.journal.record("broadcast", model=rt.name,
+                          op=_OP_SITE.get(op, str(op)), wire_seq=_wire.seq)
     _send(op, a, b, rt.spmd_index, rt.spmd_replica, values,
           rt.ecfg.max_slots, rt.ecfg.max_pages_per_seq,
           rt.ecfg.repeat_last_n)

@@ -129,6 +129,7 @@ class Server:
         r.add_route("GET", "/metrics", self.metrics)
         r.add_route("GET", "/metrics.json", self.metrics_json)
         r.add_route("GET", "/debug/trace", self.debug_trace)
+        r.add_route("GET", "/debug/journal", self.debug_journal)
         r.add_route("GET", "/debug/requests", self.debug_requests)
         r.add_route("GET", "/debug/requests/{req_id}", self.debug_request)
         r.add_route("GET", "/debug/bundle", self.debug_bundle)
@@ -405,6 +406,38 @@ class Server:
             raise ApiError(501, "this engine does not trace requests")
         return web.json_response(tracer.export_chrome())
 
+    async def debug_journal(self, request: web.Request) -> web.Response:
+        """Flight-recorder ring tail: the engine's scheduler decision
+        journal (telemetry/journal.py) with every record carrying the
+        inputs that justified the decision. Filters: `?n=` (tail length,
+        default 200), `?req_id=`, `?user=`, `?kind=` (one of the closed
+        event vocabulary — unknown kinds are a client error, not an
+        empty result)."""
+        self._ident(request)
+        journal = getattr(self.engine, "journal", None)
+        if journal is None:
+            raise ApiError(501, "this engine keeps no decision journal")
+        from ollamamq_tpu.telemetry.journal import EVENTS
+
+        q = request.query
+        try:
+            n = int(q.get("n", "200"))
+        except ValueError:
+            raise ApiError(400, "'n' must be an integer")
+        req_id = None
+        if q.get("req_id") is not None:
+            try:
+                req_id = int(q["req_id"])
+            except ValueError:
+                raise ApiError(400, "'req_id' must be an integer")
+        kind = q.get("kind")
+        if kind is not None and kind not in EVENTS:
+            raise ApiError(400, f"unknown event kind '{kind}' "
+                                f"(vocabulary: {', '.join(EVENTS)})")
+        events = journal.tail(n=n, req_id=req_id, user=q.get("user"),
+                              kind=kind)
+        return web.json_response({**journal.snapshot(), "events": events})
+
     async def debug_requests(self, request: web.Request) -> web.Response:
         """Latency attribution index: every in-flight request (with its
         current phase and how long it has sat there) plus the most recent
@@ -438,7 +471,14 @@ class Server:
                                 "the ring, or never existed)")
         from ollamamq_tpu.telemetry import attribution
 
-        return web.json_response(attribution.timeline(tr))
+        out = attribution.timeline(tr)
+        journal = getattr(self.engine, "journal", None)
+        if journal is not None:
+            # The request's slice of the decision journal: WHY it was
+            # admitted/batched/preempted/shed, alongside WHERE its time
+            # went (the phase timeline above).
+            out["journal"] = journal.tail(n=100, req_id=rid)
+        return web.json_response(out)
 
     async def debug_bundle(self, request: web.Request) -> web.Response:
         """One-shot diagnostics bundle: config, metrics, request
@@ -488,6 +528,13 @@ class Server:
         pc = getattr(eng, "prefix_cache_stats", None)
         if pc is not None:
             section("prefix_cache", pc)
+        journal = getattr(eng, "journal", None)
+        if journal is not None:
+            # Redacted flight-recorder tail: the last scheduler decisions
+            # before the incident, pasted into the ticket alongside the
+            # metrics and timelines they explain.
+            section("journal", lambda: _redact(
+                {**journal.snapshot(), "events": journal.tail(n=200)}))
         return bundle
 
     async def debug_prefix_cache(self, request: web.Request) -> web.Response:

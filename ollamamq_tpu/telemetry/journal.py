@@ -1,0 +1,550 @@
+"""Engine flight recorder: the scheduler decision journal.
+
+Every scheduler-visible decision the engine makes — admit, shed, batch
+compose, preempt, requeue, retry, poison, deadline drop, page
+alloc/free/evict, runtime rebuild — lands here as ONE typed record
+carrying the decision's *inputs* (queue depths, free/cached page counts,
+fair-share standing, deadline slack), so a bad scheduling episode
+observed in production is explainable after the fact and, for
+harness-driven runs, replayable (tools/journal replay).
+
+Design constraints, in order:
+
+  1. bounded — a deque ring of `capacity` records; memory is O(capacity)
+     no matter how long the engine runs. An optional JSONL spill
+     (--journal-file) keeps the full history on disk with size-based
+     rotation so soak runs can't fill the volume.
+  2. low overhead — nothing is recorded per decoded token; the hottest
+     sites are one record per prefill batch / chunk / page-table growth.
+     Schema validation is two frozenset subset checks.
+  3. typed — EVENTS is a CLOSED vocabulary and every kind declares its
+     required/optional fields (EVENT_FIELDS). An event kind added to the
+     engine without a README table row fails the doc gate
+     (scripts/check_metrics_docs.py), exactly like an undocumented
+     metric.
+
+Stdlib-only, like the rest of telemetry: the doc checker and the offline
+analyzer (tools/journal) import this module without jax or an engine.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ollamamq_tpu.telemetry import schema as tm
+
+# Closed event vocabulary, lifecycle order. The README "Flight recorder"
+# table (between <!-- journal-events:begin/end --> markers) documents
+# every kind; the doc gate pins the two together.
+EVENTS = (
+    "enqueue",        # arrival accepted into the fair-share queue
+    "admit",          # scheduler popped the request for placement
+    "place",          # placed onto a runtime (replica chosen)
+    "shed",           # refused/dropped instead of served, by reason
+    "batch",          # prefill batch composed (slots/bucket/occupancy)
+    "chunk",          # one chunked-prefill piece dispatched
+    "install",        # slot activated: request entered the decode batch
+    "preempt",        # victim evicted for recompute under KV pressure
+    "kv_stall",       # page growth failed; slot holds a reservation
+    "requeue",        # returned to the FRONT of its user's queue
+    "retry",          # fault-implicated request re-dispatched
+    "poison",         # retry budget spent; request errored on purpose
+    "deadline_drop",  # per-request deadline expired before completion
+    "finish",         # slot/stream finished, by reason
+    "page_alloc",     # KV pages allocated (admission or decode growth)
+    "page_free",      # KV pages returned to the free list
+    "page_evict",     # cached prefix pages reclaimed under pressure
+    "broadcast",      # SPMD primary shipped a step plan to worker hosts
+    "rebuild",        # failed runtime replaced (weights reloaded)
+)
+
+# kind -> (required fields, optional fields) beyond the common header
+# (seq, t, tick, kind, req_id, user, model). Validation is loud: an
+# instrumentation site that forgets a decision input fails its test, not
+# an operator's incident review.
+EVENT_FIELDS: Dict[str, Tuple[tuple, tuple]] = {
+    "enqueue": (("n_prompt", "queued"),
+                ("kind_req", "max_tokens", "deadline_ms")),
+    "admit": (("queued",), ()),
+    "place": (("runtime",), ()),
+    "shed": (("reason",),
+             ("queued", "limit", "retry_after_s", "n_prompt", "max_tokens")),
+    "batch": (("slots", "bucket", "batch_size", "tokens", "occupancy"),
+              ("reqs", "pending", "free_pages")),
+    "chunk": (("slot", "pos"), ("tokens", "cached")),
+    "install": (("slot",), ("n_prompt",)),
+    "preempt": (("slot", "why"),
+                ("n", "free_pages", "victim_served", "vip")),
+    "kv_stall": (("slot",), ("free_pages", "need")),
+    "requeue": ((), ("why",)),
+    "retry": (("n",), ("error",)),
+    "poison": (("retries",), ("error",)),
+    "deadline_drop": (("slack_ms",), ()),
+    "finish": (("reason",), ("slot", "tokens")),
+    "page_alloc": (("n", "free", "used", "cached", "pool"), ("slot",)),
+    "page_free": (("n", "free", "used", "cached", "pool"), ("slot",)),
+    "page_evict": (("n", "free", "used", "cached", "pool"), ()),
+    "broadcast": (("op",), ("wire_seq",)),
+    "rebuild": ((), ()),
+}
+assert set(EVENT_FIELDS) == set(EVENTS)
+
+_FIELD_SETS = {k: (frozenset(req), frozenset(req) | frozenset(opt))
+               for k, (req, opt) in EVENT_FIELDS.items()}
+
+# Kinds whose (kind, req_id, user, salient-fields) sequence defines THE
+# decision stream for deterministic replay. Page events and dispatch
+# bookkeeping (chunk/broadcast) carry device/layout detail that replay
+# harnesses without real KV pools can't reproduce; everything
+# scheduler-visible is in.
+DECISION_KINDS = ("enqueue", "admit", "place", "shed", "batch", "install",
+                  "preempt", "requeue", "retry", "poison", "deadline_drop",
+                  "finish")
+
+# Per-kind fields folded into the replay signature (deterministic given
+# the same arrivals; excludes timestamps, latencies, and page ids).
+_SIG_FIELDS = {
+    "enqueue": ("n_prompt", "queued"),
+    "shed": ("reason",),
+    "place": ("runtime",),
+    "retry": ("n",),
+    "poison": ("retries",),
+    "finish": ("reason",),
+    "preempt": ("why",),
+}
+
+
+class JournalError(ValueError):
+    """A record violated the event schema (unknown kind / bad fields)."""
+
+
+class Journal:
+    """Bounded append-only decision journal with optional JSONL spill.
+
+    Thread-safe: the engine loop appends while HTTP readers tail. The
+    ring holds plain dicts (JSON-able as-is); `seq` is a monotonically
+    increasing record index so consumers can detect ring evictions
+    (size < seq means the oldest records fell off).
+    """
+
+    def __init__(self, capacity: int = 2048, path: Optional[str] = None,
+                 rotate_bytes: int = 64_000_000, keep: int = 3,
+                 meta: Optional[dict] = None):
+        self.capacity = max(1, int(capacity))
+        self._ring: collections.deque = collections.deque(
+            maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self.seq = 0
+        # Engine-loop iteration counter; the synchronous replay driver
+        # sets it explicitly so recorded arrivals carry a deterministic
+        # virtual tick.
+        self.tick = 0
+        self.path = path or None
+        self.rotate_bytes = max(0, int(rotate_bytes))
+        self.keep = max(1, int(keep))
+        self.meta = dict(meta or {})
+        self._fh = None
+        self._bytes = 0
+        self._last_decision: Optional[dict] = None
+        self._tm = {k: tm.JOURNAL_EVENTS_TOTAL.labels(kind=k)
+                    for k in EVENTS}
+        if self.path:
+            self._open_file()
+
+    # -- file spill --------------------------------------------------------
+    def _open_file(self) -> None:
+        # Line-buffered: each record reaches the OS as it is written, so
+        # the spill is tail-able mid-incident and survives a crash — a
+        # flight recorder that only flushes on clean shutdown records
+        # nothing about the flights that matter.
+        self._fh = open(self.path, "a", encoding="utf-8", buffering=1)
+        self._bytes = self._fh.tell()
+        if self._bytes == 0:
+            head = {"journal_meta": {
+                "version": 1, "opened_at": time.time(), **self.meta}}
+            line = json.dumps(head, default=str) + "\n"
+            self._fh.write(line)
+            self._bytes += len(line)
+
+    def _rotate(self) -> None:
+        """path -> path.1 -> ... -> path.keep (oldest dropped): bounded
+        disk no matter how long the soak runs."""
+        self._fh.close()
+        for i in range(self.keep - 1, 0, -1):
+            src, dst = f"{self.path}.{i}", f"{self.path}.{i + 1}"
+            if os.path.exists(src):
+                os.replace(src, dst)
+        os.replace(self.path, f"{self.path}.1")
+        self._fh = None
+        self._open_file()
+
+    def _spill(self, rec: dict) -> None:
+        line = json.dumps(rec, default=str) + "\n"
+        self._fh.write(line)
+        self._bytes += len(line)
+        if self.rotate_bytes and self._bytes >= self.rotate_bytes:
+            self._rotate()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                self._fh.close()
+                self._fh = None
+
+    # -- recording ---------------------------------------------------------
+    def record(self, kind: str, req=None, req_id: Optional[int] = None,
+               user: Optional[str] = None, model: Optional[str] = None,
+               **fields) -> dict:
+        """Append one validated record. `req` (duck-typed Request) fills
+        req_id/user/model unless given explicitly."""
+        sets = _FIELD_SETS.get(kind)
+        if sets is None:
+            raise JournalError(f"unknown journal event kind {kind!r} "
+                               f"(vocabulary: {EVENTS})")
+        required, allowed = sets
+        got = frozenset(fields)
+        if not required <= got:
+            raise JournalError(
+                f"journal event {kind!r} missing required field(s) "
+                f"{sorted(required - got)}")
+        if not got <= allowed:
+            raise JournalError(
+                f"journal event {kind!r} got unknown field(s) "
+                f"{sorted(got - allowed)} (allowed: {sorted(allowed)})")
+        if req is not None:
+            if req_id is None:
+                req_id = getattr(req, "req_id", None)
+            if user is None:
+                user = getattr(req, "user", None)
+            if model is None:
+                model = getattr(req, "model", None)
+        rec = {"seq": 0, "t": time.monotonic(), "tick": self.tick,
+               "kind": kind}
+        if req_id is not None:
+            rec["req_id"] = int(req_id)
+        if user is not None:
+            rec["user"] = user
+        if model:
+            rec["model"] = model
+        rec.update(fields)
+        with self._lock:
+            rec["seq"] = self.seq
+            self.seq += 1
+            self._ring.append(rec)
+            if kind in DECISION_KINDS:
+                self._last_decision = rec
+            if self._fh is not None:
+                try:
+                    self._spill(rec)
+                except OSError:
+                    # Disk trouble must not take serving down; the ring
+                    # keeps recording.
+                    try:
+                        self._fh.close()
+                    except OSError:
+                        pass
+                    self._fh = None
+        self._tm[kind].inc()
+        return rec
+
+    # -- reading -----------------------------------------------------------
+    def tail(self, n: Optional[int] = 200, req_id: Optional[int] = None,
+             user: Optional[str] = None,
+             kind: Optional[str] = None) -> List[dict]:
+        """Newest-last slice of the ring, optionally filtered. n=None (or
+        <= 0) returns every retained record passing the filters."""
+        with self._lock:
+            recs = list(self._ring)
+        if req_id is not None:
+            recs = [r for r in recs if r.get("req_id") == req_id]
+        if user is not None:
+            recs = [r for r in recs if r.get("user") == user]
+        if kind is not None:
+            recs = [r for r in recs if r["kind"] == kind]
+        if n is not None and n > 0:
+            recs = recs[-n:]
+        return recs
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            size = len(self._ring)
+        return {"capacity": self.capacity, "size": size, "seq": self.seq,
+                "evicted": max(0, self.seq - size),
+                "file": self.path, "tick": self.tick}
+
+    def last_summary(self) -> str:
+        """One-line text of the most recent scheduler decision (the TUI
+        last-decision line); "" before the first decision."""
+        rec = self._last_decision
+        return explain(rec) if rec is not None else ""
+
+
+# ---------------------------------------------------------------------------
+# Explanations: per-decision human text built from the recorded inputs.
+# ---------------------------------------------------------------------------
+
+def explain(rec: dict) -> str:
+    """Human one-liner for one record: WHAT was decided and the inputs
+    that justify it."""
+    kind = rec.get("kind", "?")
+    rid = rec.get("req_id")
+    who = f"req {rid}" if rid is not None else rec.get("user", "?")
+    if rec.get("user") and rid is not None:
+        who += f" ({rec['user']})"
+    if kind == "enqueue":
+        return (f"{who} enqueued: {rec.get('n_prompt', '?')} prompt tokens, "
+                f"queue depth {rec.get('queued', '?')}")
+    if kind == "admit":
+        return f"{who} admitted (queue depth {rec.get('queued', '?')})"
+    if kind == "place":
+        return f"{who} placed on runtime {rec.get('runtime', '?')}"
+    if kind == "shed":
+        parts = [f"{who} shed ({rec.get('reason', '?')})"]
+        if "queued" in rec and "limit" in rec:
+            parts.append(f"queued {rec['queued']} >= cap {rec['limit']}")
+        if "retry_after_s" in rec:
+            parts.append(f"retry after ~{rec['retry_after_s']:.0f}s")
+        return ": ".join([parts[0], ", ".join(parts[1:])]) if parts[1:] \
+            else parts[0]
+    if kind == "batch":
+        return (f"prefill batch on {rec.get('model', '?')}: "
+                f"{len(rec.get('slots', []))} req(s) in bucket "
+                f"{rec.get('bucket', '?')} (B={rec.get('batch_size', '?')}, "
+                f"{rec.get('tokens', '?')} real tokens, occupancy "
+                f"{rec.get('occupancy', 0):.2f})")
+    if kind == "chunk":
+        return (f"{who} prefill chunk at pos {rec.get('pos', '?')} "
+                f"({rec.get('tokens', '?')} tokens, slot {rec.get('slot')})")
+    if kind == "install":
+        return f"{who} installed in slot {rec.get('slot', '?')}"
+    if kind == "preempt":
+        s = (f"{who} preempted from slot {rec.get('slot', '?')} "
+             f"({rec.get('why', '?')}, n={rec.get('n', '?')})")
+        if "free_pages" in rec:
+            s += f": free_pages={rec['free_pages']}"
+        if "victim_served" in rec:
+            s += f", victim served {rec['victim_served']} lifetime requests"
+        return s
+    if kind == "kv_stall":
+        return (f"{who} stalled holding slot {rec.get('slot', '?')} "
+                f"(free_pages={rec.get('free_pages', '?')})")
+    if kind == "requeue":
+        return f"{who} requeued to queue front"
+    if kind == "retry":
+        return (f"{who} retry #{rec.get('n', '?')}"
+                + (f": {rec['error']}" if rec.get("error") else ""))
+    if kind == "poison":
+        return (f"{who} poisoned after {rec.get('retries', '?')} retr"
+                f"{'y' if rec.get('retries') == 1 else 'ies'}")
+    if kind == "deadline_drop":
+        return (f"{who} dropped: deadline expired "
+                f"{rec.get('slack_ms', 0):.0f}ms ago")
+    if kind == "finish":
+        return (f"{who} finished ({rec.get('reason', '?')}"
+                + (f", {rec['tokens']} tokens" if "tokens" in rec else "")
+                + ")")
+    if kind in ("page_alloc", "page_free", "page_evict"):
+        verb = {"page_alloc": "allocated", "page_free": "freed",
+                "page_evict": "evicted"}[kind]
+        return (f"{rec.get('model', '?')}: {rec.get('n', '?')} page(s) "
+                f"{verb} (free={rec.get('free')}, used={rec.get('used')}, "
+                f"cached={rec.get('cached')}, pool={rec.get('pool')})")
+    if kind == "broadcast":
+        return (f"SPMD plan broadcast: {rec.get('op', '?')} "
+                f"(wire seq {rec.get('wire_seq', '?')})")
+    if kind == "rebuild":
+        return f"runtime {rec.get('model', '?')} rebuilt (weights reloaded)"
+    return f"{kind} {who}"
+
+
+# ---------------------------------------------------------------------------
+# Replay signature: the normalized decision stream two runs must agree on.
+# ---------------------------------------------------------------------------
+
+def decision_signature(records: List[dict]) -> List[tuple]:
+    out = []
+    for r in records:
+        kind = r.get("kind")
+        if kind not in DECISION_KINDS:
+            continue
+        salient = tuple(r.get(f) for f in _SIG_FIELDS.get(kind, ()))
+        out.append((kind, r.get("req_id"), r.get("user"), salient))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Invariant checker: turns any journal (live ring tail, JSONL file, chaos
+# run artifact) into a checked artifact. Tolerant of partial windows: a
+# ring that evicted its head must not fabricate violations.
+# ---------------------------------------------------------------------------
+
+# An admitted request must reach a slot (install) or a terminal decision
+# within this many subsequent prefill batches, or it is starving.
+STARVATION_BATCHES = 50
+
+
+def check_invariants(records: List[dict],
+                     starve_after: int = STARVATION_BATCHES) -> List[str]:
+    """Returns violation strings (empty = clean). Checked invariants:
+
+      1. pages conserved — every page event's post-state satisfies
+         free + used + cached == pool;
+      2. no slot double-assignment — an install on a slot whose observed
+         holder never finished/preempted is a scheduler bug;
+      3. preempt victim is never the VIP;
+      4. shed only when bounds exceeded — a queue_full/user_queue_full
+         shed whose recorded depth is below the recorded cap lied;
+      5. no admitted request starves past `starve_after` prefill batches
+         without progress (install/finish/requeue/retry/shed/preempt).
+    """
+    bad: List[str] = []
+    # (model, slot) -> req_id currently observed holding it.
+    held: Dict[tuple, int] = {}
+    # req_id -> batch ordinal at admit time (starvation tracking).
+    admitted: Dict[int, int] = {}
+    batches = 0
+    progress = ("install", "finish", "requeue", "retry", "shed",
+                "preempt", "deadline_drop", "poison")
+    for r in records:
+        kind = r.get("kind")
+        seq = r.get("seq", "?")
+        rid = r.get("req_id")
+        if kind in ("page_alloc", "page_free", "page_evict"):
+            free, used = r.get("free"), r.get("used")
+            cached, pool = r.get("cached"), r.get("pool")
+            if None not in (free, used, cached, pool) \
+                    and free + used + cached != pool:
+                bad.append(
+                    f"seq {seq}: pages not conserved after {kind}: "
+                    f"free {free} + used {used} + cached {cached} "
+                    f"!= pool {pool}")
+        elif kind == "install" and (r.get("slot") or 0) >= 0:
+            # slot -1 = an unslotted runtime (FakeRuntime): nothing to
+            # double-assign.
+            key = (r.get("model"), r.get("slot"))
+            holder = held.get(key)
+            if holder is not None and holder != rid:
+                bad.append(
+                    f"seq {seq}: slot double-assignment: slot {key[1]} of "
+                    f"{key[0]} installed for req {rid} while held by "
+                    f"req {holder}")
+            held[key] = rid
+        elif kind in ("finish", "preempt"):
+            slot = r.get("slot")
+            if slot is not None and slot >= 0:
+                held.pop((r.get("model"), slot), None)
+        if kind == "preempt":
+            vip = r.get("vip")
+            if vip is not None and r.get("user") is not None \
+                    and r.get("user") == vip:
+                bad.append(
+                    f"seq {seq}: preempt victim req {rid} IS the VIP "
+                    f"({vip})")
+        if kind == "shed" and r.get("reason") in ("queue_full",
+                                                  "user_queue_full"):
+            queued, limit = r.get("queued"), r.get("limit")
+            if queued is not None and limit is not None and queued < limit:
+                bad.append(
+                    f"seq {seq}: shed ({r['reason']}) below bound: "
+                    f"queued {queued} < cap {limit}")
+        if kind == "batch":
+            batches += 1
+        if kind == "admit" and rid is not None:
+            admitted[rid] = batches
+        elif kind in progress and rid is not None:
+            admitted.pop(rid, None)
+    for rid, at_batch in admitted.items():
+        if batches - at_batch >= starve_after:
+            bad.append(
+                f"req {rid} starved: admitted at batch {at_batch} with no "
+                f"progress through batch {batches} "
+                f"(>= {starve_after} cycles)")
+    return bad
+
+
+# ---------------------------------------------------------------------------
+# Batch stats: occupancy / padding-waste from the composed-batch records
+# (bench.py folds this into the BENCH JSON line).
+# ---------------------------------------------------------------------------
+
+def batch_stats(records: List[dict]) -> dict:
+    """Occupancy and padding-waste summary over `batch` records.
+
+    padding_waste = fraction of dispatched prefill token positions
+    (bucket x batch rows) that were padding, the compute the bucketing
+    scheme burned for shape stability."""
+    batches = [r for r in records if r.get("kind") == "batch"]
+    if not batches:
+        return {"batches": 0, "mean_occupancy": 0.0,
+                "padding_waste": 0.0, "real_tokens": 0, "padded_tokens": 0}
+    occ = sum(r.get("occupancy", 0.0) for r in batches) / len(batches)
+    real = sum(int(r.get("tokens", 0)) for r in batches)
+    padded = sum(int(r.get("bucket", 0)) * int(r.get("batch_size", 0))
+                 for r in batches)
+    return {
+        "batches": len(batches),
+        "mean_occupancy": round(occ, 4),
+        "padding_waste": round(1.0 - real / padded, 4) if padded else 0.0,
+        "real_tokens": real,
+        "padded_tokens": padded,
+    }
+
+
+def fair_share_audit(records: List[dict]) -> dict:
+    """Per-user decision accounting: enqueued/admitted/shed/preempted/
+    finished counts — the offline answer to "who was the scheduler
+    actually serving, and at whose expense"."""
+    users: Dict[str, Dict[str, int]] = {}
+    for r in records:
+        u = r.get("user")
+        if u is None:
+            continue
+        row = users.setdefault(u, {"enqueued": 0, "admitted": 0, "shed": 0,
+                                   "preempted": 0, "finished": 0,
+                                   "deadline_dropped": 0})
+        k = r["kind"]
+        if k == "enqueue":
+            row["enqueued"] += 1
+        elif k == "admit":
+            row["admitted"] += 1
+        elif k == "shed":
+            row["shed"] += 1
+        elif k == "preempt":
+            row["preempted"] += 1
+        elif k == "finish":
+            row["finished"] += 1
+        elif k == "deadline_drop":
+            row["deadline_dropped"] += 1
+    return users
+
+
+def load_jsonl(path: str) -> Tuple[dict, List[dict]]:
+    """Read a spilled journal file: (meta, records). Lines without a
+    "kind" key (the header) feed meta; malformed lines are skipped with
+    a count in meta["parse_errors"]."""
+    meta: dict = {}
+    records: List[dict] = []
+    errors = 0
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError:
+                errors += 1
+                continue
+            if "kind" in obj:
+                records.append(obj)
+            elif "journal_meta" in obj:
+                meta = obj["journal_meta"]
+    if errors:
+        meta["parse_errors"] = errors
+    return meta, records

@@ -161,6 +161,13 @@ WATCHDOG_STALLS_TOTAL = REGISTRY.counter(
     "Stall watchdog firings by kind (engine_step, request_phase, "
     "worker_host, device)", labels=("kind",))
 
+# -- decision journal (telemetry/journal.py; GET /debug/journal) -----------
+JOURNAL_EVENTS_TOTAL = REGISTRY.counter(
+    "ollamamq_journal_events_total",
+    "Scheduler decision-journal records appended, by event kind (the "
+    "flight recorder's write rate; tail the ring at /debug/journal)",
+    labels=("kind",))
+
 # -- host / device ---------------------------------------------------------
 HBM_USED_BYTES = REGISTRY.gauge(
     "ollamamq_hbm_used_bytes",

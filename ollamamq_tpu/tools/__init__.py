@@ -1,0 +1,2 @@
+"""Operator tools: offline consumers of engine artifacts (the decision
+journal analyzer/replayer lives in tools/journal.py)."""

@@ -1,0 +1,315 @@
+"""Offline decision-journal analyzer + deterministic replay harness.
+
+    python -m ollamamq_tpu.tools.journal <command> [args]
+
+Commands over a spilled journal (--journal-file JSONL, or a file written
+by `record`):
+
+    tail FILE      raw records (filters: --n/--req-id/--user/--kind)
+    explain FILE   per-decision human explanations (same filters)
+    stats FILE     batch occupancy + padding-waste + fair-share audit
+    check FILE     invariant checker (exit 1 on any violation)
+
+Record/replay (the determinism acceptance loop):
+
+    record FILE [--seed N] [--requests N]
+        drive a seeded chaos run — bursty arrivals over a bounded queue
+        against a FakeRuntime engine with a seeded fault plan (injected
+        step faults => retries and poisons; admission caps => sheds) —
+        SYNCHRONOUSLY (one virtual tick at a time, no engine thread), and
+        spill the journal to FILE. Synchronous driving is what makes the
+        decision stream a pure function of (seed, arrival sequence).
+
+    replay FILE
+        re-drive a `record`-ed run from the journal's own arrival
+        sequence (enqueue + admission-shed records) under the same fault
+        plan, and assert the replayed decision sequence is IDENTICAL
+        (telemetry/journal.py decision_signature). Exit 0 on a perfect
+        match, 1 with the first divergence printed otherwise.
+
+Stdlib + engine imports only on demand: tail/explain/stats/check need no
+jax and no engine.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from ollamamq_tpu.telemetry.journal import (EVENTS, Journal, batch_stats,
+                                            check_invariants,
+                                            decision_signature, explain,
+                                            fair_share_audit, load_jsonl)
+
+# The chaos scenario's engine shape: small on purpose (4 slots, bounded
+# queue) so a couple dozen arrivals saturate it and every degradation
+# decision — shed, retry, poison — shows up in the journal.
+_SCENARIO_ENGINE = {"max_slots": 4, "max_queued": 6,
+                    "max_queued_per_user": 3, "step_retries": 1}
+# Injected step faults: the whole fake step raises, driving the engine's
+# retry-then-poison containment path deterministically (call-count
+# triggered, so wall-clock never enters the decision stream).
+_SCENARIO_FAULTS = {"seed": 0, "faults": [
+    {"site": "step", "kind": "exception", "every": 7, "times": 4},
+]}
+
+
+def _gen_arrivals(seed: int, n: int) -> List[dict]:
+    import random
+
+    rng = random.Random(seed)
+    out, tick = [], 0
+    for _ in range(n):
+        # Bursty: several arrivals share a tick, then a small gap.
+        if rng.random() < 0.4:
+            tick += rng.randrange(1, 4)
+        out.append({"tick": tick, "user": f"u{rng.randrange(4)}",
+                    "n_prompt": rng.randrange(3, 40),
+                    "max_tokens": rng.choice((2, 4, 8, 12))})
+    return out
+
+
+def _arrivals_from_records(records: List[dict]) -> List[dict]:
+    """The recorded arrival sequence: every accepted enqueue AND every
+    admission-shed attempt (a shed arrival never became a Request, but
+    replay must re-attempt it to reproduce the shed decision)."""
+    out = []
+    for r in records:
+        if r["kind"] == "enqueue" or (
+                r["kind"] == "shed"
+                and r.get("reason") in ("queue_full", "user_queue_full")):
+            out.append({"tick": r.get("tick", 0), "user": r.get("user", "?"),
+                        "n_prompt": int(r.get("n_prompt") or 4),
+                        "max_tokens": int(r.get("max_tokens") or 8)})
+    return out
+
+
+def drive_chaos(arrivals: List[dict], fault_plan: dict, engine: dict,
+                journal: Journal):
+    """Synchronously drive a FakeRuntime engine through the arrival
+    sequence, journaling every decision into `journal`. Deterministic by
+    construction: virtual ticks, zero retry backoff, call-count-triggered
+    faults — wall-clock never reaches a decision site."""
+    from ollamamq_tpu.config import EngineConfig
+    from ollamamq_tpu.engine.engine import QueueFullError
+    from ollamamq_tpu.engine.fake import FakeEngine
+    from ollamamq_tpu.ops.sampling import SamplingParams
+    from ollamamq_tpu.testing.faults import FaultPlan
+
+    ecfg = EngineConfig(model="test-tiny", retry_backoff_s=0.0,
+                        fault_plan=FaultPlan.from_dict(fault_plan),
+                        **engine)
+    eng = FakeEngine(ecfg, blocklist_path=None)
+    eng.journal = journal  # the caller's journal (file spill, meta)
+    for rt in eng._step_targets():
+        rt.journal = journal
+    by_tick: dict = {}
+    for a in arrivals:
+        by_tick.setdefault(int(a["tick"]), []).append(a)
+    last = max(by_tick) if by_tick else 0
+    tick, guard = 0, 0
+    while True:
+        journal.tick = tick
+        for a in by_tick.get(tick, ()):
+            try:
+                eng.enqueue_request(
+                    a["user"], "", "test-tiny",
+                    prompt_tokens=[1] * int(a["n_prompt"]),
+                    sampling=SamplingParams(max_tokens=int(a["max_tokens"])))
+            except QueueFullError:
+                pass  # the shed decision is already journaled
+        eng._admit()
+        for rt in eng._step_targets():
+            rt.check_cancellations(eng.core)
+            if rt.has_work():
+                try:
+                    rt.step(eng.core)
+                except Exception:
+                    # Same containment contract as FakeEngine._loop.
+                    eng._fail_runtime(rt, "engine step failed")
+        busy = (eng.core.total_queued() > 0
+                or any(rt.has_work() for rt in eng._step_targets()))
+        if tick >= last and not busy:
+            break
+        tick += 1
+        guard += 1
+        if guard > 100_000:
+            raise RuntimeError("chaos drive did not converge")
+    journal.close()
+    return eng
+
+
+def record_chaos(path: str, seed: int = 0, requests: int = 24) -> Journal:
+    """Record one seeded chaos run to `path` (JSONL + scenario meta);
+    returns the in-memory journal."""
+    arrivals = _gen_arrivals(seed, requests)
+    meta = {"scenario": {"seed": seed, "requests": requests,
+                         "engine": dict(_SCENARIO_ENGINE),
+                         "fault_plan": dict(_SCENARIO_FAULTS)}}
+    journal = Journal(capacity=max(4096, requests * 64), path=path,
+                      meta=meta)
+    drive_chaos(arrivals, _SCENARIO_FAULTS, _SCENARIO_ENGINE, journal)
+    return journal
+
+
+def replay_journal(path: str):
+    """Re-drive the recorded run; returns (ok, recorded_sig, replayed_sig,
+    first_divergence_index_or_None)."""
+    meta, records = load_jsonl(path)
+    scenario = meta.get("scenario")
+    if not scenario:
+        raise SystemExit(
+            f"{path} carries no scenario meta: replay needs a journal "
+            "written by `tools/journal record` (a live engine's spill "
+            "lacks the engine shape + fault plan to re-drive)")
+    arrivals = _arrivals_from_records(records)
+    fresh = Journal(capacity=max(4096, len(records) + 64))
+    drive_chaos(arrivals, scenario["fault_plan"], scenario["engine"], fresh)
+    rec_sig = decision_signature(records)
+    rep_sig = decision_signature(fresh.tail(None))
+    if rec_sig == rep_sig:
+        return True, rec_sig, rep_sig, None
+    div = next((i for i, (a, b) in enumerate(zip(rec_sig, rep_sig))
+                if a != b), min(len(rec_sig), len(rep_sig)))
+    return False, rec_sig, rep_sig, div
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _filtered(records: List[dict], args) -> List[dict]:
+    if args.req_id is not None:
+        records = [r for r in records if r.get("req_id") == args.req_id]
+    if args.user:
+        records = [r for r in records if r.get("user") == args.user]
+    if args.kind:
+        records = [r for r in records if r.get("kind") == args.kind]
+    if args.n and args.n > 0:
+        records = records[-args.n:]
+    return records
+
+
+def _cmd_tail(args) -> int:
+    _meta, records = load_jsonl(args.file)
+    for r in _filtered(records, args):
+        print(json.dumps(r))
+    return 0
+
+
+def _cmd_explain(args) -> int:
+    _meta, records = load_jsonl(args.file)
+    for r in _filtered(records, args):
+        print(f"[{r.get('seq', '?'):>6} t{r.get('tick', '?')}] {explain(r)}")
+    return 0
+
+
+def _cmd_stats(args) -> int:
+    _meta, records = load_jsonl(args.file)
+    bs = batch_stats(records)
+    print("batch stats:")
+    for k, v in bs.items():
+        print(f"  {k}: {v}")
+    print("fair-share audit (per user):")
+    audit = fair_share_audit(records)
+    for user in sorted(audit):
+        row = audit[user]
+        cells = "  ".join(f"{k}={v}" for k, v in row.items())
+        print(f"  {user}: {cells}")
+    kinds: dict = {}
+    for r in records:
+        kinds[r["kind"]] = kinds.get(r["kind"], 0) + 1
+    print("events by kind:")
+    for k in sorted(kinds, key=kinds.get, reverse=True):
+        print(f"  {k}: {kinds[k]}")
+    return 0
+
+
+def _cmd_check(args) -> int:
+    _meta, records = load_jsonl(args.file)
+    bad = check_invariants(records)
+    if bad:
+        print(f"{len(bad)} invariant violation(s):", file=sys.stderr)
+        for b in bad:
+            print(f"  - {b}", file=sys.stderr)
+        return 1
+    print(f"ok: {len(records)} records, all invariants hold "
+          "(pages conserved, no slot double-assignment, victim never VIP, "
+          "sheds only over bounds, no starvation)")
+    return 0
+
+
+def _cmd_record(args) -> int:
+    journal = record_chaos(args.file, seed=args.seed, requests=args.requests)
+    recs = journal.tail(None)
+    kinds: dict = {}
+    for r in recs:
+        kinds[r["kind"]] = kinds.get(r["kind"], 0) + 1
+    print(f"recorded {journal.seq} decision records to {args.file} "
+          f"(seed={args.seed}, {args.requests} arrivals)")
+    print("  " + "  ".join(f"{k}={kinds[k]}" for k in sorted(kinds)))
+    bad = check_invariants(recs)
+    if bad:
+        print(f"WARNING: {len(bad)} invariant violation(s) in the recorded "
+              "run", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _cmd_replay(args) -> int:
+    ok, rec_sig, rep_sig, div = replay_journal(args.file)
+    if ok:
+        print(f"replay deterministic: {len(rep_sig)} decisions identical")
+        return 0
+    print(f"REPLAY DIVERGED at decision {div} "
+          f"(recorded {len(rec_sig)}, replayed {len(rep_sig)}):",
+          file=sys.stderr)
+    lo, hi = max(0, div - 2), div + 3
+    for i in range(lo, hi):
+        a = rec_sig[i] if i < len(rec_sig) else "<end>"
+        b = rep_sig[i] if i < len(rep_sig) else "<end>"
+        mark = " " if a == b else "!"
+        print(f" {mark} [{i}] recorded={a}  replayed={b}", file=sys.stderr)
+    return 1
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m ollamamq_tpu.tools.journal",
+        description="decision-journal analyzer + deterministic replay")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def add_filters(sp):
+        sp.add_argument("file")
+        sp.add_argument("--n", type=int, default=0,
+                        help="tail length (0 = all)")
+        sp.add_argument("--req-id", type=int, default=None)
+        sp.add_argument("--user", default="")
+        sp.add_argument("--kind", default="", choices=("",) + EVENTS)
+
+    for name, fn in (("tail", _cmd_tail), ("explain", _cmd_explain)):
+        sp = sub.add_parser(name)
+        add_filters(sp)
+        sp.set_defaults(fn=fn)
+    for name, fn in (("stats", _cmd_stats), ("check", _cmd_check),
+                     ("replay", _cmd_replay)):
+        sp = sub.add_parser(name)
+        sp.add_argument("file")
+        sp.set_defaults(fn=fn)
+    sp = sub.add_parser("record")
+    sp.add_argument("file")
+    sp.add_argument("--seed", type=int, default=0)
+    sp.add_argument("--requests", type=int, default=24)
+    sp.set_defaults(fn=_cmd_record)
+    return p
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

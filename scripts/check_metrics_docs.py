@@ -12,9 +12,15 @@
   3. shed reasons — the closed `ollamamq_shed_total{reason}` label
      vocabulary (telemetry/schema.py SHED_REASONS) must match the README
      shed-reason table (between the `<!-- shed-reasons:begin -->` /
-     `<!-- shed-reasons:end -->` markers) exactly.
+     `<!-- shed-reasons:end -->` markers) exactly;
+  4. journal events — the decision-journal event vocabulary
+     (telemetry/journal.py EVENTS) must match the README "Flight
+     recorder" table (between the `<!-- journal-events:begin -->` /
+     `<!-- journal-events:end -->` markers) exactly: an event kind the
+     engine can record but the table doesn't document is a drift
+     failure, and so is a documented kind the journal no longer emits.
 
-Imports ONLY ollamamq_tpu.telemetry.schema and .attribution — the
+Imports ONLY ollamamq_tpu.telemetry.schema/.attribution/.journal — the
 declaration sites — so the check runs without jax, a device, or an
 engine. Wired into tier-1 via tests/test_metrics_docs.py.
 
@@ -34,6 +40,8 @@ PHASES_BEGIN = "<!-- phases:begin -->"
 PHASES_END = "<!-- phases:end -->"
 SHED_BEGIN = "<!-- shed-reasons:begin -->"
 SHED_END = "<!-- shed-reasons:end -->"
+JOURNAL_BEGIN = "<!-- journal-events:begin -->"
+JOURNAL_END = "<!-- journal-events:end -->"
 
 
 def documented_metric_names(readme_text: str) -> set:
@@ -85,6 +93,22 @@ def registered_shed_reasons() -> set:
     return set(SHED_REASONS)
 
 
+def documented_journal_events(readme_text: str) -> set:
+    """Backticked names inside the marked journal-event region."""
+    start = readme_text.find(JOURNAL_BEGIN)
+    end = readme_text.find(JOURNAL_END)
+    if start == -1 or end == -1 or end < start:
+        return set()
+    return set(re.findall(r"`([a-z_]+)`", readme_text[start:end]))
+
+
+def registered_journal_events() -> set:
+    sys.path.insert(0, _REPO)
+    from ollamamq_tpu.telemetry.journal import EVENTS
+
+    return set(EVENTS)
+
+
 def _diff(readme: str, what: str, registered: set, documented: set,
           missing_msg: str, ghost_msg: str) -> int:
     rc = 0
@@ -128,10 +152,17 @@ def main(argv) -> int:
         "shed reason(s) missing from the README shed-reason table "
         f"(between {SHED_BEGIN} / {SHED_END})",
         "documented shed reason(s) the engine no longer emits")
+    rc |= _diff(
+        readme, "journal events", registered_journal_events(),
+        documented_journal_events(text),
+        "journal event kind(s) missing from the README flight-recorder "
+        f"table (between {JOURNAL_BEGIN} / {JOURNAL_END})",
+        "documented journal event kind(s) the engine no longer records")
     if rc == 0:
         print(f"ok: {len(registered_metric_names())} metrics, "
-              f"{len(registered_phase_names())} phases, and "
-              f"{len(registered_shed_reasons())} shed reasons, "
+              f"{len(registered_phase_names())} phases, "
+              f"{len(registered_shed_reasons())} shed reasons, and "
+              f"{len(registered_journal_events())} journal events, "
               "all documented")
     return rc
 

@@ -36,6 +36,33 @@ def test_checker_catches_missing_and_ghost_names(tmp_path):
     assert mod.main(["check_metrics_docs.py", str(ghost)]) == 1
 
 
+def test_checker_pins_journal_event_table(tmp_path):
+    """Satellite: every decision-journal event kind the engine can record
+    (telemetry/journal.py EVENTS) must appear in the README flight-
+    recorder table (marker-scoped), and the table must not document
+    kinds the journal no longer emits — the checker exits non-zero on
+    any drift, and this test gates it in tier-1."""
+    mod = _load()
+    with open(os.path.join(_REPO, "README.md"), encoding="utf-8") as f:
+        full = f.read()
+    assert "| `preempt` |" in full, "journal table row shape changed"
+    # A documented event row removed => missing-event failure.
+    missing = tmp_path / "README_noevent.md"
+    missing.write_text(full.replace("| `preempt` |", "| preempt-less |", 1))
+    assert mod.main(["check_metrics_docs.py", str(missing)]) == 1
+    # A ghost kind inside the markers => ghost-event failure.
+    ghost = tmp_path / "README_ghostevent.md"
+    ghost.write_text(full.replace(
+        mod.JOURNAL_END,
+        "| `notarealevent` | bogus |\n" + mod.JOURNAL_END, 1))
+    assert mod.main(["check_metrics_docs.py", str(ghost)]) == 1
+    # Markers stripped => every kind reads as undocumented.
+    bare = tmp_path / "README_nojournalmarkers.md"
+    bare.write_text(full.replace(mod.JOURNAL_BEGIN, "").replace(
+        mod.JOURNAL_END, ""))
+    assert mod.main(["check_metrics_docs.py", str(bare)]) == 1
+
+
 def test_checker_pins_attribution_phase_table(tmp_path):
     """Satellite: every phase the attribution layer can emit must appear
     in the README phase table (marker-scoped), and the table must not

@@ -229,6 +229,35 @@ def test_tui_alerts_panel_via_pty(tmp_path):
         t.close()
 
 
+# Engine stub with a decision journal holding a preempt record: the
+# chips panel must render the flight recorder's last-decision line.
+_CHILD_JOURNAL = _CHILD.replace(
+    'eng.runtimes = {}\nadmin_tui.run_tui(eng, None, refresh_ms=50)',
+    '''eng.runtimes = {}
+from ollamamq_tpu.telemetry.journal import Journal
+eng.journal = Journal(capacity=32)
+eng.journal.record("preempt", req_id=42, user="mallory", model="test-tiny",
+                   slot=3, why="kv_pressure", n=1, free_pages=0,
+                   victim_served=9, vip="alice")
+admin_tui.run_tui(eng, None, refresh_ms=50)''')
+assert _CHILD_JOURNAL != _CHILD, "journal child patch failed to apply"
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="pty/termios test")
+def test_tui_last_decision_line_via_pty(tmp_path):
+    """ISSUE 5: the newest scheduler decision renders as a `last:` line
+    in the chips panel, with the inputs that justified it."""
+    t = _PtyTui(tmp_path, child_src=_CHILD_JOURNAL)
+    try:
+        assert t.wait_output(b"last: req 42 (mallory) preempted"), _stderr(t)
+        assert t.wait_output(b"free_pages=0"), _stderr(t)
+        t.send("q")
+        assert t.wait_output(b"TUI_EXIT_OK"), _stderr(t)
+        assert t.proc.wait(timeout=30) == 0
+    finally:
+        t.close()
+
+
 @pytest.mark.skipif(sys.platform != "linux", reason="pty/termios test")
 def test_tui_no_alerts_renders_quiet_panel(tmp_path):
     """Without an alert table (or with it empty) the ALERTS section still
