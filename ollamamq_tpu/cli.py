@@ -52,6 +52,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-new-tokens", type=int, default=256)
     p.add_argument("--decode-steps", type=int, default=8,
                    help="decode steps fused per dispatch when idle")
+    p.add_argument("--attention", choices=("ragged", "bucketed"),
+                   default="ragged",
+                   help="batch composition: 'ragged' (default) packs any "
+                        "mix of prefill spans and decode tokens into one "
+                        "token-budget dispatch (no bucket padding); "
+                        "'bucketed' keeps the legacy same-bucket padded "
+                        "batches as a byte-identical diff-testing oracle "
+                        "for one release")
+    p.add_argument("--max-batch-tokens", type=int, default=512,
+                   help="token budget of one ragged dispatch (decode rows "
+                        "+ prefill-span tokens); clamped up so a full "
+                        "decode batch always fits")
+    p.add_argument("--token-granule", type=int, default=16,
+                   help="ragged streams pad their TOTAL token count to "
+                        "this granule (the only padding the ragged path "
+                        "pays; one compile per padded total)")
     p.add_argument("--prefix-cache", action="store_true",
                    help="automatic prefix caching: share finished prompts' "
                         "KV pages (page-granular radix tree) across "
@@ -226,6 +242,9 @@ def main(argv=None) -> int:
         log.error("--journal-ring / --journal-keep / --log-keep "
                   "must be >= 1")
         return 2
+    if args.token_granule < 1 or args.max_batch_tokens < 1:
+        log.error("--token-granule / --max-batch-tokens must be >= 1")
+        return 2
     if args.journal_rotate_mb < 0 or args.log_rotate_mb < 0:
         log.error("--journal-rotate-mb / --log-rotate-mb must be >= 0 "
                   "(0 disables rotation)")
@@ -291,6 +310,9 @@ def main(argv=None) -> int:
         max_pages_per_seq=args.max_pages_per_seq,
         max_new_tokens=args.max_new_tokens,
         decode_steps_per_iter=args.decode_steps,
+        attention_mode=args.attention,
+        max_batch_tokens=args.max_batch_tokens,
+        token_granule=args.token_granule,
         prefix_cache=args.prefix_cache,
         prefix_cache_min_pages=args.prefix_cache_min_pages,
         dp=args.dp,

@@ -207,8 +207,27 @@ class EngineConfig:
     page_size: int = 32
     # Max pages a single sequence may hold (=> max context length).
     max_pages_per_seq: int = 16
-    # Prefill length buckets (padded; each bucket compiles once).
+    # Prefill length buckets (padded; each bucket compiles once). Used by
+    # the bucketed oracle path (attention_mode="bucketed") and, in both
+    # modes, as the chunk ceiling for the sequence-parallel prefill
+    # hand-off.
     prefill_buckets: tuple = (32, 64, 128, 256, 512, 1024, 2048)
+    # -- ragged mixed-batch attention ----------------------------------------
+    # "ragged" (default): ONE token-budget dispatch packs any mix of
+    # variable-length prefill spans and decode tokens into a flattened
+    # stream (Pallas ragged kernel on TPU, jnp twin elsewhere) — no
+    # power-of-two bucket padding. "bucketed": the legacy same-bucket
+    # batch composition, kept for one release as a byte-identical
+    # diff-testing oracle (--attention=bucketed).
+    attention_mode: str = "ragged"
+    # Token budget of one ragged dispatch: decode rows (1 token per
+    # active slot) plus as many prefill-tail tokens as fit. Clamped up
+    # to max_slots + token_granule so a full decode batch always fits.
+    max_batch_tokens: int = 512
+    # The ONLY padding the ragged path pays: the stream's total token
+    # count rounds up to this granule for shape stability (one compile
+    # per padded total). Small => waste bounded by granule/batch_tokens.
+    token_granule: int = 16
     # Max new tokens default when request doesn't specify.
     max_new_tokens: int = 256
     # Decode steps executed per host-loop iteration when no prefill pending

@@ -460,6 +460,33 @@ class ModelRuntime:
         # Flips true after the first successful decode dispatch; until then
         # a pallas failure falls back to jnp instead of failing the runtime.
         self._pallas_proven = False
+        # Ragged mixed-batch scheduling: prefill spans + decode tokens
+        # pack into ONE token-budget dispatch (no bucket padding). The
+        # pipeline-parallel forward is stage-scheduled and keeps the
+        # bucketed path; everything else defaults to ragged.
+        self.ragged = engine_cfg.attention_mode == "ragged" and self._pp == 1
+        if engine_cfg.attention_mode == "ragged" and self._pp > 1:
+            log.warning("%s: pp=%d serves the bucketed prefill path "
+                        "(the ragged forward is single-stage)", name,
+                        self._pp)
+        g = max(1, engine_cfg.token_granule)
+        # A full decode batch (one token per slot) plus at least one
+        # granule of prefill must always fit one dispatch.
+        self._granule = g
+        self._ragged_budget = -(-max(engine_cfg.max_batch_tokens,
+                                     engine_cfg.max_slots + g) // g) * g
+        # Allowed stream totals: a power-of-two ladder over the granule,
+        # capped by the budget — one compile per rung (like the bucketed
+        # path's per-bucket compiles, but the composer TRIMS the last
+        # span down to a rung instead of padding up to one, so steady-
+        # state dispatches still pay (near) zero padding).
+        ladder = []
+        v = g
+        while v < self._ragged_budget:
+            ladder.append(v)
+            v *= 2
+        ladder.append(self._ragged_budget)
+        self._ragged_ladder = ladder
 
         # Telemetry.
         self.step_latency_ms = 0.0
@@ -476,6 +503,7 @@ class ModelRuntime:
         self._tm_step = tm.STEP_LATENCY_MS.labels(model=name)
         self._tm_prefill = tm.PREFILL_LATENCY_MS.labels(model=name)
         self._tm_occupancy = tm.BATCH_OCCUPANCY.labels(model=name)
+        self._tm_padding = tm.BATCH_PADDING_WASTE.labels(model=name)
         self._tm_pages = tm.KV_PAGES_USED.labels(model=name)
         self._tm_page_util = tm.KV_PAGE_UTILIZATION.labels(model=name)
         self._tm_mfu = tm.MFU.labels(model=name)
@@ -563,10 +591,19 @@ class ModelRuntime:
 
     # -- compiled steps ----------------------------------------------------
     def _bucket_for(self, n: int) -> int:
+        """Smallest prefill bucket covering n tokens. Oversize pieces
+        must have been routed to the chunked/sequence-parallel path by
+        the caller — silently answering the largest bucket here would
+        truncate the forward's view of the prompt and mask a packing
+        bug (the bucketed path is the ragged path's diff-testing
+        oracle, so it must fail loudly, not approximately)."""
         for b in self.ecfg.prefill_buckets:
             if n <= b:
                 return b
-        return self.ecfg.prefill_buckets[-1]
+        raise ValueError(
+            f"piece of {n} tokens exceeds the largest prefill bucket "
+            f"{self.ecfg.prefill_buckets[-1]}; oversize prompts must take "
+            "the chunked or sequence-parallel prefill path")
 
     def _next_key(self):
         self._rng_counter += 1
@@ -625,6 +662,89 @@ class ModelRuntime:
                   jnp.asarray(pt_row), jnp.asarray(temp), jnp.asarray(tk),
                   jnp.asarray(tp), jnp.asarray(pen), jnp.asarray(pres),
                   jnp.asarray(freq), jnp.asarray(seeds), key)
+
+    def _dispatch_ragged(self, T_pad, tokens, tok_seq, tok_pos, write_slots,
+                         q_start, q_len, kv_len, ring_len, is_first, append,
+                         seed_rows, slot_ids, pt, temp, tk, tp, pen, pres,
+                         freq, seeds, key):
+        self._fault("ragged")
+        fn = self._get_ragged_jit(
+            T_pad, sampling_flags(temp, tk, tp, pen, pres, freq)
+        )
+        return fn(self.params, jnp.asarray(tokens), jnp.asarray(tok_seq),
+                  jnp.asarray(tok_pos), jnp.asarray(write_slots),
+                  jnp.asarray(q_start), jnp.asarray(q_len),
+                  jnp.asarray(kv_len), jnp.asarray(ring_len),
+                  jnp.asarray(is_first), jnp.asarray(append),
+                  jnp.asarray(seed_rows), jnp.asarray(slot_ids),
+                  jnp.asarray(pt), self.kc, self.vc, self.recent,
+                  jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp),
+                  jnp.asarray(pen), jnp.asarray(pres), jnp.asarray(freq),
+                  jnp.asarray(seeds), key)
+
+    def _get_ragged_jit(self, T_pad: int, flags=(True, True, True)):
+        """ONE mixed-batch step: forward the flattened [T_pad] token
+        stream (prefill spans + decode tokens) through forward_ragged,
+        then per-sequence penalty-ring maintenance and sampling — the
+        ragged-mode replacement for the prefill, chunk, AND single-step
+        decode jits. Compiles once per (padded token total, sampling
+        flags); the engine pads totals to the token granule to keep the
+        variant count small."""
+        key_ = ("ragged", T_pad, flags)
+        if key_ not in self._prefill_jits:
+            cfg, ps = self.cfg, self.ecfg.page_size
+            attn_impl = self.attn_impl
+            need_pen, need_mask, need_sample = flags
+
+            def fn(params, tokens, tok_seq, tok_pos, write_slots, q_start,
+                   q_len, kv_len, ring_len, is_first, append, seed_rows,
+                   slot_ids, pt, kc, vc, recent, temp, tk, tp, pen, pres,
+                   freq, seeds, key):
+                last_idx = jnp.clip(q_start + q_len - 1, 0, T_pad - 1)
+                logits, kc, vc = llama.forward_ragged(
+                    params, cfg, tokens, tok_seq, tok_pos, write_slots,
+                    last_idx, kc, vc, pt, q_start, q_len, kv_len, ps,
+                    attn_impl=attn_impl,
+                )
+                W = recent.shape[1]
+                rows = recent[slot_ids]  # [B, W]
+                # First span of a request: the ring opens from seed_rows
+                # (all -1 fresh, the cached prefix's last W tokens on a
+                # prefix-cache hit) — chunk-jit semantics, vectorized.
+                rows = jnp.where(is_first[:, None] > 0, seed_rows, rows)
+                # Slide each ring by ring_len tokens taken from the tail
+                # of the row's own stream span (ring_len = span length
+                # for prefill rows, 0 for decode rows whose input token
+                # already rolled in when it was sampled). new[j] is
+                # (rows ++ span)[ring_len + j] kept to the last W.
+                j = jnp.arange(W)[None, :]
+                cidx = ring_len[:, None] + j - W  # offset into the span
+                stream_idx = jnp.clip(q_start[:, None] + cidx, 0, T_pad - 1)
+                from_stream = tokens[stream_idx]  # [B, W]
+                row_idx = jnp.clip(ring_len[:, None] + j, 0, W - 1)
+                from_row = jnp.take_along_axis(rows, row_idx, axis=1)
+                new_rows = jnp.where(cidx >= 0, from_stream, from_row)
+                pen_logits = maybe_apply_penalties(logits, new_rows, pen,
+                                                   pres, freq, need_pen)
+                # kv_len IS the position being sampled in both shapes:
+                # n for a span ending a prompt of n tokens (prefill
+                # folded seq_lens) and positions+1 for a decode row.
+                row_keys = per_row_keys(key, seeds, kv_len)
+                tok = sample_tokens_rowwise(pen_logits, row_keys, temp, tk,
+                                            tp, need_mask, need_sample)
+                # Rows that EMIT (decode rows, final prefill spans) roll
+                # the sampled token in; mid-prefill spans do not.
+                appended = jnp.concatenate([new_rows[:, 1:], tok[:, None]],
+                                           axis=1)
+                final_rows = jnp.where(append[:, None] > 0, appended,
+                                       new_rows)
+                recent = recent.at[slot_ids].set(final_rows)
+                return tok, kc, vc, recent
+
+            self._prefill_jits[key_] = jax.jit(
+                fn, donate_argnums=(14, 15, 16)
+            )
+        return self._prefill_jits[key_]
 
     def _dev(self, name: str, arr) -> jnp.ndarray:
         """Content-fingerprinted device cache for small per-slot arrays.
@@ -1202,15 +1322,19 @@ class ModelRuntime:
         # padded shape it pays for, and the occupancy/backlog inputs the
         # composition saw — the offline analyzer's padding-waste and
         # occupancy stats read straight off these.
+        real_tokens = int(sum(n for *_, n in batch))
         self._jrec("batch",
                    slots=[slot for _, slot, _, _ in batch],
                    reqs=[req.req_id for req, *_ in batch],
                    bucket=bucket, batch_size=B,
-                   tokens=int(sum(n for *_, n in batch)),
+                   tokens=real_tokens,
                    occupancy=round(self.active_count()
                                    / max(1, self.ecfg.max_slots), 4),
                    pending=len(self.pending_prefill),
-                   free_pages=self.alloc.free_pages)
+                   free_pages=self.alloc.free_pages,
+                   mode="bucketed", padded_tokens=int(bucket * B))
+        self._tm_padding.set(
+            round(1.0 - real_tokens / max(1, bucket * B), 4))
         t0 = time.monotonic()
         try:
             toks, self.kc, self.vc, self.recent = self._dispatch_prefill(
@@ -1657,6 +1781,442 @@ class ModelRuntime:
         self.page_table[slot, :] = req._pt_row[0]
         self._install_slot(slot, req, n, int(np.asarray(tok)[0]), core)
         return True
+
+    # -- ragged mixed-batch scheduling -------------------------------------
+    def _admit_ragged(self, core: MQCore) -> bool:
+        """Admission for the ragged path: claim a reserved slot + the
+        full page allocation for each pending prompt and queue it on
+        `chunking` — EVERY prefill rides the span path, sized each tick
+        by the token budget instead of a bucket. Prefix-cache hits pin
+        their shared pages and start the span at the cached boundary.
+        Returns True if anything was admitted."""
+        did = False
+        largest = self.ecfg.prefill_buckets[-1]
+        while self.pending_prefill:
+            req = self.pending_prefill[0]
+            if req.cancelled.is_set():
+                self.pending_prefill.popleft()
+                core.mark_dropped(req.user)
+                self._jrec("finish", req, reason="cancelled")
+                req.finish(FinishReason.CANCELLED)
+                continue
+            if req._retry_at > time.monotonic():
+                break  # head is backing off after a contained fault
+            if req.expired():
+                self.pending_prefill.popleft()
+                drop_expired(req, core, self.name, journal=self.journal)
+                continue
+            n = len(req.prompt_tokens)
+            max_prompt = min(self.ecfg.max_context - 1,
+                             self.cfg.max_seq_len - 1)
+            if n > max_prompt:
+                self.pending_prefill.popleft()
+                core.mark_dropped(req.user)
+                self._jrec("finish", req, reason="error")
+                req.finish(
+                    FinishReason.ERROR,
+                    error=f"prompt length {n} exceeds maximum {max_prompt}",
+                )
+                continue
+            if self._sp and n > largest:
+                # Long prompts on a sequence-parallel mesh keep the
+                # one-shot ring-attention prefill (its activations shard
+                # over the seq axis; the ragged stream does not).
+                slot = self._claim_slot(set())
+                if slot is None:
+                    return did
+                pages = self._alloc_pages(n + 1)
+                if pages is None:
+                    return did
+                self.pending_prefill.popleft()
+                self._pc_miss()
+                req.stats.prefill_started_at = time.monotonic()
+                self.slot_pages[slot] = pages
+                self._prefill_sp(req, slot, n, core)
+                return True
+            nodes, shared = ([], [])
+            if self.prefix_cache is not None:
+                nodes, shared = self._match_prefix(req.prompt_tokens)
+            slot = self._claim_slot(set())
+            if slot is None:
+                break
+            if nodes:
+                # Pin BEFORE the tail allocation: its eviction backstop
+                # must never reclaim the very pages we matched.
+                self.prefix_cache.pin(nodes)
+                tail = self._alloc_tail(len(shared), n + 1)
+                if tail is None:
+                    self.prefix_cache.release(nodes)
+                    break  # wait for frees
+                prefix_len = len(shared) * self.ecfg.page_size
+                self.slot_pins[slot] = list(nodes)
+                self.slot_pages[slot] = list(shared) + tail
+                self.prefix_cache.note_hit(prefix_len)
+                req.trace_event("prefix_hit", cached_tokens=prefix_len,
+                                tokens=n)
+                req._chunk_pos = prefix_len
+                req._chunk_base = prefix_len
+            else:
+                pages = self._alloc_pages(n + 1)
+                if pages is None:
+                    break  # pool exhausted; retry after frees
+                self._pc_miss()
+                self.slot_pages[slot] = pages
+                req._chunk_pos = 0
+                req._chunk_base = 0
+            self.pending_prefill.popleft()
+            req.stats.prefill_started_at = time.monotonic()
+            # The row stays OFF the shared page table until install —
+            # decode steps write through self.page_table and a reserved
+            # slot must keep pointing at the trash page meanwhile.
+            req._pt_row = kvc.make_page_table_row(
+                self.slot_pages[slot], self.ecfg.max_pages_per_seq
+            )[None, :]
+            req._prefill_slot = slot
+            self.reserved_slots.add(slot)
+            self.chunking.append(req)
+            did = True
+        return did
+
+    def _drop_chunking(self, req: Request, slot: int) -> None:
+        """Remove a span-path request (cancel/overflow): release its
+        pages + reservation without finishing it (caller decides)."""
+        try:
+            self.chunking.remove(req)
+        except ValueError:
+            pass
+        self._release_slot_pages(slot)
+        self.reserved_slots.discard(slot)
+
+    def step_ragged(self, core: MQCore) -> bool:
+        """ONE ragged mixed-batch tick: admit pending prompts, then pack
+        every live decode slot (one token each) plus as many prefill-
+        span tokens as the --max-batch-tokens budget allows into a
+        single dispatch — prompts of any length mix freely, and the only
+        padding is the stream total rounding up to the token granule.
+        Returns True when a mixed dispatch ran (decode slots advanced
+        one step inside it); False leaves decode to the fused-scan path.
+        """
+        self._admit_ragged(core)
+        if not self.chunking:
+            return False
+
+        # Decode-row page headroom for one token, as step_decode_dispatch
+        # does per chunk (reservation-holders get their retry first).
+        for i in sorted(self._stalled_slots):
+            if self.slot_req[i] is None:
+                self._stalled_slots.discard(i)
+            elif self._extend_pages(self.slot_pages[i],
+                                    int(self.seq_lens[i]) + 1):
+                self._stalled_slots.discard(i)
+        for i, r in enumerate(self.slot_req):
+            if r is None or i in self._stalled_slots:
+                continue
+            need = int(self.seq_lens[i]) + 1
+            if not self._extend_pages(self.slot_pages[i], need):
+                self._page_exhausted(i, need, core)
+            if self.slot_req[i] is not None and i not in self._stalled_slots:
+                self.page_table[i, :] = kvc.make_page_table_row(
+                    self.slot_pages[i], self.ecfg.max_pages_per_seq
+                )
+
+        # Compose: decode rows first (every live stream advances), then
+        # prefill spans in FIFO order until the budget runs out.
+        budget = self._ragged_budget
+        rows: List[tuple] = []  # (kind, slot, req, chunk_pos, span)
+        for i, r in enumerate(self.slot_req):
+            if r is not None and i not in self._stalled_slots:
+                rows.append(("decode", i, r, 0, 1))
+        n_decode = len(rows)
+        budget -= n_decode
+        now = time.monotonic()
+        for req in list(self.chunking):
+            if budget <= 0:
+                break
+            slot = req._prefill_slot
+            if req.cancelled.is_set() or req.stream.overflowed:
+                self._drop_chunking(req, slot)
+                core.mark_dropped(req.user)
+                self._jrec("finish", req, reason="cancelled")
+                req.finish(FinishReason.CANCELLED)
+                continue
+            if req.expired():
+                self._drop_chunking(req, slot)
+                drop_expired(req, core, self.name, journal=self.journal)
+                continue
+            if req._retry_at > now:
+                continue  # backing off after a contained fault
+            span = min(len(req.prompt_tokens) - req._chunk_pos, budget)
+            if span <= 0:
+                continue
+            rows.append(("prefill", slot, req, req._chunk_pos, span))
+            budget -= span
+        if len(rows) == n_decode:
+            return False  # no span ready this tick: decode runs fused
+
+        # Pick the dispatch total from the compile ladder. Prefer the
+        # largest rung we can TRIM down to (tail prefill tokens just go
+        # next tick — no compute wasted); pad up to the next rung only
+        # when the decode rows alone nearly fill the stream and leave no
+        # prefill slack to trim.
+        T_raw = sum(span for *_, span in rows)
+        L = None
+        for v in reversed(self._ragged_ladder):
+            if v <= T_raw and v >= n_decode + 1:
+                L = v
+                break
+        if L is None:
+            L = next(v for v in self._ragged_ladder if v >= T_raw)
+        if L < T_raw:
+            cut, acc = [], 0
+            for row in rows:
+                take = min(row[4], L - acc)
+                if take <= 0:
+                    break  # trailing spans wait for the next tick
+                cut.append(row[:4] + (take,))
+                acc += take
+            rows = cut
+
+        S = self.ecfg.max_slots
+        MP = self.ecfg.max_pages_per_seq
+        W = self.ecfg.repeat_last_n
+        ps = self.ecfg.page_size
+        T_real = sum(span for *_, span in rows)
+        T_pad = L
+
+        tokens = np.zeros(T_pad, np.int32)
+        # Padding tokens belong to padding row len(rows) (trash pages,
+        # position -1 => masked everywhere) and write into the trash page.
+        tok_seq = np.full(T_pad, min(len(rows), S - 1), np.int32)
+        tok_pos = np.full(T_pad, -1, np.int32)
+        write_slots = np.zeros(T_pad, np.int32)  # trash page slot 0
+        q_start = np.full(S, T_pad, np.int32)
+        q_len = np.zeros(S, np.int32)
+        kv_len = np.zeros(S, np.int32)
+        ring_len = np.zeros(S, np.int32)
+        is_first = np.zeros(S, np.int32)
+        append = np.zeros(S, np.int32)
+        seed_rows = np.full((S, W), -1, np.int32)
+        slot_ids = np.full(S, S, np.int32)  # padding -> trash ring row
+        pt_rows = np.full((S, MP), kvc.TRASH_PAGE, np.int32)
+        temp = np.zeros(S, np.float32)
+        top_k = np.zeros(S, np.int32)
+        top_p = np.ones(S, np.float32)
+        pen = np.ones(S, np.float32)
+        pres = np.zeros(S, np.float32)
+        freq = np.zeros(S, np.float32)
+        seeds = np.zeros(S, np.int32)
+
+        off = 0
+        for idx, (kind, slot, req, cpos, span) in enumerate(rows):
+            s = req.sampling
+            slot_ids[idx] = slot
+            q_start[idx] = off
+            q_len[idx] = span
+            temp[idx] = s.temperature
+            top_k[idx] = s.top_k
+            top_p[idx] = s.top_p
+            pen[idx] = s.repeat_penalty
+            pres[idx] = s.presence_penalty
+            freq[idx] = s.frequency_penalty
+            seeds[idx] = s.seed
+            if kind == "decode":
+                pos = int(self.seq_lens[slot])
+                tokens[off] = self.last_tokens[slot]
+                tok_seq[off] = idx
+                tok_pos[off] = pos
+                row = self.page_table[slot]
+                write_slots[off] = row[pos // ps] * ps + pos % ps
+                kv_len[idx] = pos + 1
+                append[idx] = 1  # ring_len 0: input token already rolled
+                pt_rows[idx] = row
+            else:
+                piece = req.prompt_tokens[cpos:cpos + span]
+                tokens[off:off + span] = piece
+                tok_seq[off:off + span] = idx
+                positions = np.arange(cpos, cpos + span, dtype=np.int32)
+                tok_pos[off:off + span] = positions
+                row = req._pt_row[0]
+                write_slots[off:off + span] = (
+                    row[positions // ps] * ps + positions % ps)
+                kv_len[idx] = cpos + span
+                ring_len[idx] = span
+                first = 1 if cpos == req._chunk_base else 0
+                is_first[idx] = first
+                if first and cpos > 0:
+                    # Prefix-cache hit: the ring opens with the cached
+                    # prefix's last W tokens, as a full prefill would.
+                    prev = req.prompt_tokens[max(0, cpos - W):cpos]
+                    seed_rows[idx, W - len(prev):] = prev
+                final = cpos + span >= len(req.prompt_tokens)
+                append[idx] = 1 if final else 0
+                pt_rows[idx] = row
+                req.trace_event("prefill_chunk", pos=cpos, tokens=span)
+                self._jrec("chunk", req, slot=slot, pos=cpos, tokens=span,
+                           cached=req._chunk_base)
+            off += span
+
+        prefill_rows = [r for r in rows if r[0] == "prefill"]
+        self.inflight_prefill = [req for _, _, req, _, _ in prefill_rows]
+        self._jrec("batch",
+                   slots=[slot for _, slot, *_ in rows],
+                   reqs=[req.req_id for _, _, req, _, _ in rows],
+                   batch_size=len(rows), tokens=int(T_real),
+                   occupancy=round(len(rows) / max(1, S), 4),
+                   pending=(len(self.pending_prefill)
+                            + len(self.chunking)),
+                   free_pages=self.alloc.free_pages,
+                   mode="ragged", padded_tokens=int(T_pad),
+                   n_decode=n_decode, n_prefill=len(prefill_rows))
+        if (self.attn_impl == "pallas" and not self._pallas_proven
+                and jax.process_count() == 1):
+            # Probe the unproven Pallas ragged kernel with an AOT compile
+            # BEFORE the real dispatch (the decode path's pattern):
+            # lower().compile() executes nothing and donates nothing, so
+            # a Mosaic compile failure flips us to the jnp reference
+            # attention with the KV state untouched.
+            try:
+                probe_flags = sampling_flags(temp, top_k, top_p, pen,
+                                             pres, freq)
+                self._get_ragged_jit(T_pad, probe_flags).lower(
+                    self.params, jnp.asarray(tokens), jnp.asarray(tok_seq),
+                    jnp.asarray(tok_pos), jnp.asarray(write_slots),
+                    jnp.asarray(q_start), jnp.asarray(q_len),
+                    jnp.asarray(kv_len), jnp.asarray(ring_len),
+                    jnp.asarray(is_first), jnp.asarray(append),
+                    jnp.asarray(seed_rows), jnp.asarray(slot_ids),
+                    jnp.asarray(pt_rows), self.kc, self.vc, self.recent,
+                    jnp.asarray(temp), jnp.asarray(top_k),
+                    jnp.asarray(top_p), jnp.asarray(pen),
+                    jnp.asarray(pres), jnp.asarray(freq),
+                    jnp.asarray(seeds), jax.random.PRNGKey(0),
+                ).compile()
+                self._pallas_proven = True
+            except Exception:
+                log.exception(
+                    "pallas ragged kernel failed to compile; serving falls "
+                    "back to jnp attention for runtime %s", self.name,
+                )
+                self.attn_impl = "jnp"
+                self._decode_jits.clear()
+                self._prefill_jits = {
+                    k: v for k, v in self._prefill_jits.items()
+                    if not (isinstance(k, tuple) and k
+                            and k[0] == "ragged")
+                }
+        t0 = time.monotonic()
+        try:
+            toks, self.kc, self.vc, self.recent = self._dispatch_ragged(
+                T_pad, tokens, tok_seq, tok_pos, write_slots, q_start,
+                q_len, kv_len, ring_len, is_first, append, seed_rows,
+                slot_ids, pt_rows, temp, top_k, top_p, pen, pres, freq,
+                seeds, self._next_key(),
+            )
+            toks = np.asarray(toks)
+        except Exception as e:
+            self._ragged_failed(rows, e, core)
+            return True
+        finally:
+            self.inflight_prefill = []
+        dt = time.monotonic() - t0
+
+        waste = (T_pad - T_real) / max(1, T_pad)
+        self._tm_padding.set(round(waste, 4))
+        if prefill_rows:
+            self.prefill_latency_ms = dt * 1e3
+            self._tm_prefill.observe(self.prefill_latency_ms)
+        if n_decode:
+            self.step_latency_ms = dt * 1e3
+            self.step_window.append(self.step_latency_ms)
+            self._tm_step.observe(self.step_latency_ms)
+            self._tm_tpot.observe(self.step_latency_ms)
+            if self.slo is not None:
+                self.slo.record("tpot", self.step_latency_ms, n=n_decode)
+
+        emitted = 0
+        for idx, (kind, slot, req, cpos, span) in enumerate(rows):
+            if kind == "decode":
+                if self.slot_req[slot] is not req:
+                    continue  # finished/cancelled between compose & emit
+                tok = int(toks[idx])
+                self.seq_lens[slot] += 1
+                self.tokens_generated += 1
+                emitted += 1
+                if self._emit_token(slot, tok, core):
+                    self.last_tokens[slot] = tok
+            else:
+                req._chunk_pos = cpos + span
+                if req._chunk_pos >= len(req.prompt_tokens):
+                    # Final span: publish the page-table row (decode may
+                    # write through it from now on), install, emit.
+                    try:
+                        self.chunking.remove(req)
+                    except ValueError:
+                        pass
+                    self.reserved_slots.discard(slot)
+                    self.page_table[slot, :] = req._pt_row[0]
+                    self._install_slot(slot, req,
+                                       len(req.prompt_tokens),
+                                       int(toks[idx]), core)
+
+        self._tm_tokens.inc(emitted)
+        active = [i for i, r in enumerate(self.slot_req) if r is not None]
+        self._tm_occupancy.set(len(active) / max(1, S))
+        self._tm_pages.set(self.alloc.used_pages)
+        self._tm_page_util.set(
+            self.alloc.used_pages / max(1, self.alloc.num_pages - 1))
+        mean_ctx = (float(np.mean([kv_len[i] for i in range(len(rows))]))
+                    if rows else 0.0)
+        # MFU over EVERY real token the dispatch processed (prefill
+        # spans do the same per-token matmuls as decode rows).
+        self.mfu = mfu_model.mfu(self._orig_cfg, int(T_real), dt,
+                                 self.peak_flops, n_chips=self.n_chips,
+                                 context_len=mean_ctx)
+        self._tm_mfu.set(self.mfu)
+        return True
+
+    def _ragged_failed(self, rows, e: Exception, core: MQCore) -> None:
+        """Contain a failed mixed dispatch: prefill spans release their
+        reservation and retry from scratch; decode rows fold their
+        generated tokens into a replay prompt (preemption semantics —
+        the stream resumes byte-identically) and retry too. A worker
+        desync still propagates: diverged SPMD state must kill+reload."""
+        desync = isinstance(e, WorkerDesyncError)
+        log.exception("ragged mixed dispatch failed (%d rows)", len(rows))
+        for kind, slot, req, _cpos, _span in rows:
+            if kind == "prefill":
+                self._drop_chunking(req, slot)
+                if desync or not self._retry_requeue(
+                        req, self.pending_prefill,
+                        f"ragged dispatch failed: {e}"):
+                    core.mark_dropped(req.user)
+                    req.finish(FinishReason.ERROR, error=self._poison_msg(
+                        req, f"ragged dispatch failed: {e}"))
+            else:
+                r = self.slot_req[slot]
+                if r is None:
+                    continue
+                # Journaled as a preempt: the slot's holder is released
+                # for replay-recompute — the invariant checker (and any
+                # postmortem) must see the seat change hands.
+                self._jrec("preempt", r, slot=slot, why="dispatch_fault",
+                           n=r.retries + 1,
+                           free_pages=self.alloc.free_pages)
+                replay = r.prompt_tokens + r.generated_ids[r._replay_gen:]
+                written = len(replay) - 1 if r.generated_ids else len(replay)
+                r.prompt_tokens = replay[:written]
+                self._release_slot_pages(slot, r if written else None)
+                r.prompt_tokens = replay
+                r._replay_gen = len(r.generated_ids)
+                self._clear_slot(slot)
+                if desync or not self._retry_requeue(
+                        r, self.pending_prefill,
+                        f"ragged dispatch failed: {e}"):
+                    core.mark_dropped(r.user)
+                    r.finish(FinishReason.ERROR, error=self._poison_msg(
+                        r, f"ragged dispatch failed: {e}"))
+        if desync:
+            raise e
 
     def step_decode(self, core: MQCore, k_steps: int = 1) -> int:
         """Advance all active slots by up to k_steps tokens. Returns #tokens."""
@@ -2869,24 +3429,36 @@ class TPUEngine:
             try:
                 rt.check_cancellations(self.core)
                 if isinstance(rt, ModelRuntime):
-                    # TTFT first: admit pending prefills into free slots —
-                    # but bounded per tick, so a sustained arrival storm
-                    # can't starve the active decode streams below
-                    # (VERDICT r3 weak #5).
-                    budget = self.ecfg.prefill_batches_per_tick
-                    while (budget > 0 and rt.pending_prefill
-                           and rt.step_prefill(self.core)):
-                        budget -= 1
-                        did_work = True
-                    # One chunk of any long-prompt prefill per tick,
-                    # interleaved with decode below.
-                    if rt.step_chunk(self.core):
-                        did_work = True
+                    ran_ragged = False
+                    if getattr(rt, "ragged", False):
+                        # Ragged mixed batch: admission + ONE token-budget
+                        # dispatch packing prefill spans AND every live
+                        # decode slot (each advances one token inside it).
+                        if rt.step_ragged(self.core):
+                            ran_ragged = True
+                            did_work = True
+                    else:
+                        # Bucketed oracle path (--attention=bucketed).
+                        # TTFT first: admit pending prefills into free
+                        # slots — but bounded per tick, so a sustained
+                        # arrival storm can't starve the active decode
+                        # streams below (VERDICT r3 weak #5).
+                        budget = self.ecfg.prefill_batches_per_tick
+                        while (budget > 0 and rt.pending_prefill
+                               and rt.step_prefill(self.core)):
+                            budget -= 1
+                            did_work = True
+                        # One chunk of any long-prompt prefill per tick,
+                        # interleaved with decode below.
+                        if rt.step_chunk(self.core):
+                            did_work = True
                     # Embeds on a generative model: one stateless batch
                     # forward, no slot/page contention with decode.
                     if rt.pending_embed and rt.step_embed(self.core):
                         did_work = True
-                    if any(r is not None for r in rt.slot_req):
+                    if ran_ragged:
+                        pass  # decode advanced inside the mixed dispatch
+                    elif any(r is not None for r in rt.slot_req):
                         # Short decode chunks (k=1) keep TTFT low ONLY
                         # when an admission could actually land between
                         # steps: pending work AND a free seat, or a

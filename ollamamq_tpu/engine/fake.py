@@ -94,6 +94,7 @@ class FakeRuntime:
             self.fault_plan.check("step")
         # Admit everything pending (fake engine has no real slot pressure).
         # NOTE: core.mark_started already ran in TPUEngine._admit.
+        admitted: List[Request] = []
         while self.pending_prefill:
             if self.pending_prefill[0]._retry_at > time.monotonic():
                 break  # head is backing off after a contained fault
@@ -131,6 +132,19 @@ class FakeRuntime:
                 self._jrec("install", req, slot=-1,
                            n_prompt=len(req.prompt_tokens))
                 self.active.append(req)
+                admitted.append(req)
+        if admitted:
+            # Batch-compose record, fake shape: no padding (tokens are
+            # words, not tensors), so real == padded — keeps the replay
+            # harness's batch_stats/occupancy output meaningful.
+            real = sum(len(r.prompt_tokens) for r in admitted)
+            self._jrec("batch", slots=[-1] * len(admitted),
+                       reqs=[r.req_id for r in admitted],
+                       batch_size=len(admitted), tokens=real,
+                       occupancy=round(len(self.active)
+                                       / max(1, self.ecfg.max_slots), 4),
+                       pending=len(self.pending_prefill),
+                       mode="fake", padded_tokens=real)
         self._tm_occupancy.set(len(self.active) / max(1, self.ecfg.max_slots))
         if self.token_latency_s:
             time.sleep(self.token_latency_s)

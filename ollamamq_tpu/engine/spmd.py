@@ -33,6 +33,8 @@ Opcode header (int32[5]: [op, a, b, model_ordinal, replica_ordinal]):
     OP_EVICT    = 8; payload carries name (runtime /api/delete)
     OP_EMBED    = 9, a=B, b=bucket (embed batch on a GENERATIVE runtime:
                                     causal forward + mean pool, stateless)
+    OP_RAGGED   = 10, a=T_pad      (ragged mixed batch: prefill spans +
+                                    decode rows in one flattened stream)
 
 Data parallelism under SPMD: dp replicas each live on a slice of the
 mesh's data axis. make_mesh arranges the dp axis intra-host when
@@ -90,6 +92,7 @@ OP_RELOAD = 6
 OP_LOAD = 7
 OP_EVICT = 8
 OP_EMBED = 9  # a=B, b=bucket: embed batch on a GENERATIVE runtime
+OP_RAGGED = 10  # a=T_pad: ragged mixed batch (prefill spans + decode rows)
 
 KEY_SHAPE = (2,)  # raw uint32 threefry key data
 NAME_LEN = 128  # utf-8 bytes, zero-padded, for OP_LOAD/OP_EVICT names
@@ -319,6 +322,15 @@ def payload_spec(op, a, b, S, MP, W):
     if op == OP_PREFILL_SP:
         return [((1, a), np.int32), ((1,), np.int32), ((1,), np.int32),
                 ((1, MP), np.int32)] + samp(1) + key
+    if op == OP_RAGGED:
+        T = a
+        # tokens, tok_seq, tok_pos, write_slots; then per-sequence
+        # q_start, q_len, kv_len, ring_len, is_first, append, slot_ids,
+        # seed_rows, page tables, sampling, key.
+        return ([((T,), np.int32)] * 4
+                + [((S,), np.int32)] * 7
+                + [((S, W), np.int32), ((S, MP), np.int32)]
+                + samp(S) + key)
     if op in (OP_ENCODE, OP_EMBED):
         B, bucket = a, b
         return [((B, bucket), np.int32), ((B,), np.int32)]
@@ -501,8 +513,8 @@ def _raise_on_worker_failure(flags: Optional[np.ndarray], name: str) -> None:
 
 
 _OP_SITE = {OP_PREFILL: "prefill", OP_CHUNK: "chunk", OP_DECODE: "decode",
-            OP_PREFILL_SP: "sp_prefill", OP_EMBED: "embed",
-            OP_ENCODE: "encode"}
+            OP_PREFILL_SP: "sp_prefill", OP_RAGGED: "ragged",
+            OP_EMBED: "embed", OP_ENCODE: "encode"}
 
 
 def _mirrored_dispatch(rt, op, a, b, values, dispatch):
@@ -628,6 +640,25 @@ class SPMDModelRuntime(ModelRuntime):
             lambda: super(SPMDModelRuntime, self)._dispatch_prefill_sp(
                 T, tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen,
                 pres, freq, seeds, key))
+
+    def _dispatch_ragged(self, T_pad, tokens, tok_seq, tok_pos, write_slots,
+                         q_start, q_len, kv_len, ring_len, is_first, append,
+                         seed_rows, slot_ids, pt, temp, tk, tp, pen, pres,
+                         freq, seeds, key):
+        if not self._spmd:
+            return super()._dispatch_ragged(
+                T_pad, tokens, tok_seq, tok_pos, write_slots, q_start,
+                q_len, kv_len, ring_len, is_first, append, seed_rows,
+                slot_ids, pt, temp, tk, tp, pen, pres, freq, seeds, key)
+        return self._mirrored(
+            OP_RAGGED, T_pad, 0,
+            (tokens, tok_seq, tok_pos, write_slots, q_start, q_len, kv_len,
+             ring_len, is_first, append, slot_ids, seed_rows, pt, temp, tk,
+             tp, pen, pres, freq, seeds, key),
+            lambda: super(SPMDModelRuntime, self)._dispatch_ragged(
+                T_pad, tokens, tok_seq, tok_pos, write_slots, q_start,
+                q_len, kv_len, ring_len, is_first, append, seed_rows,
+                slot_ids, pt, temp, tk, tp, pen, pres, freq, seeds, key))
 
     def _dispatch_embed(self, B, bucket, tokens, lens):
         if not self._spmd:
@@ -947,7 +978,7 @@ def run_worker(
     MP = engine_cfg.max_pages_per_seq
     W = engine_cfg.repeat_last_n
     DATA_OPS = (OP_PREFILL, OP_CHUNK, OP_DECODE, OP_PREFILL_SP, OP_ENCODE,
-                OP_EMBED)
+                OP_EMBED, OP_RAGGED)
 
     wire_seq = 0
     while max_steps is None or steps < max_steps:
@@ -1102,6 +1133,17 @@ def _replay(rt, op, a, b, payload):
         toks, rt.kc, rt.vc, rt.recent = ModelRuntime._dispatch_prefill_sp(
             rt, T, tokens, lens, slot_ids, pt_rows, temp, tk, tp,
             pen, pres, freq, seeds, key)
+        return (toks, rt.kc, rt.vc, rt.recent)
+    elif op == OP_RAGGED:
+        T_pad = a
+        (tokens, tok_seq, tok_pos, write_slots, q_start, q_len, kv_len,
+         ring_len, is_first, append, slot_ids, seed_rows, pt, temp, tk,
+         tp, pen, pres, freq, seeds, key_data) = payload
+        key = jnp.asarray(key_data, jnp.uint32)
+        toks, rt.kc, rt.vc, rt.recent = ModelRuntime._dispatch_ragged(
+            rt, T_pad, tokens, tok_seq, tok_pos, write_slots, q_start,
+            q_len, kv_len, ring_len, is_first, append, seed_rows, slot_ids,
+            pt, temp, tk, tp, pen, pres, freq, seeds, key)
         return (toks, rt.kc, rt.vc, rt.recent)
     elif op == OP_ENCODE:
         B, bucket = a, b

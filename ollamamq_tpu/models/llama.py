@@ -29,6 +29,7 @@ from ollamamq_tpu.ops.attention import (
     flat_slot_indices,
     paged_chunk_attention_blockwise,
     paged_decode_attention_any,
+    ragged_attention_any,
 )
 from ollamamq_tpu.ops.rope import apply_rope
 
@@ -242,6 +243,63 @@ def forward_prefill_chunk(
     last = jnp.clip(chunk_lens - 1, 0, C - 1)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)
     logits = _logits(params, cfg, x_last)[:, 0, :]
+    return logits, k_cache, v_cache
+
+
+def forward_ragged(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [T] int32 flattened mixed-batch token stream
+    tok_seq: jnp.ndarray,  # [T] int32 sequence (batch row) per token
+    tok_pos: jnp.ndarray,  # [T] int32 kv position per token (-1 = pad)
+    write_slots: jnp.ndarray,  # [T] int32 flat cache slot per token
+    last_idx: jnp.ndarray,  # [B] int32 stream index of each seq's last token
+    k_cache: jnp.ndarray,  # [L, S, Hk, hd] (donated)
+    v_cache: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, max_pages]
+    q_start: jnp.ndarray,  # [B] span offset per sequence
+    q_len: jnp.ndarray,  # [B] span length (0 = padding row)
+    kv_len: jnp.ndarray,  # [B] context length incl. the span
+    page_size: int,
+    attn_impl: str = "jnp",  # "jnp" reference | "pallas" ragged TPU kernel
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """ONE forward over a ragged mixed batch: variable-length prefill
+    spans and single decode tokens share a flattened [T] token stream —
+    no per-sequence bucket padding. Each layer writes the stream's K/V
+    into its pages, then every token attends causally over its own
+    sequence's paged context (generalizes forward_prefill_chunk to many
+    sequences and forward_decode to multi-token spans). Returns
+    (last-token logits [B, V], caches'); padding rows (q_len == 0) yield
+    garbage logits the caller ignores.
+    """
+    T = tokens.shape[0]
+    x = params["embed"][tokens].astype(params["embed"].dtype)[None]  # [1,T,D]
+    positions = jnp.maximum(tok_pos, 0)[None, :]  # [1, T] RoPE positions
+    valid = (tok_pos >= 0)[None, :]
+
+    def body(carry, per_layer):
+        x = carry
+        lp, kc, vc = per_layer
+
+        def attn_fn(q, k, v):  # [1, T, H, hd]
+            nonlocal kc, vc
+            kc = kc.at[write_slots].set(k[0])
+            vc = vc.at[write_slots].set(v[0])
+            out = ragged_attention_any(
+                attn_impl, q[0], kc, vc, page_table, tok_seq, tok_pos,
+                kv_len, q_start, q_len, page_size, interpret=interpret,
+            )
+            return out[None]
+
+        x, _, _ = _layer_step(cfg, lp, x, positions, attn_fn, valid=valid)
+        return x, (kc, vc)
+
+    x, (k_cache, v_cache) = jax.lax.scan(
+        body, x, (params["layers"], k_cache, v_cache)
+    )
+    x_last = x[0][last_idx]  # [B, D]
+    logits = _logits(params, cfg, x_last[None])[0]  # [B, V]
     return logits, k_cache, v_cache
 
 

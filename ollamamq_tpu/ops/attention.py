@@ -211,6 +211,149 @@ def paged_decode_attention(
     return out[:, 0]
 
 
+def ragged_paged_attention(
+    q: jnp.ndarray,  # [T, H, hd] flattened mixed-batch query stream
+    k_cache: jnp.ndarray,  # [S, Hk, hd] flat slot pool for ONE layer
+    v_cache: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, max_pages] one row per sequence
+    tok_seq: jnp.ndarray,  # [T] int32 sequence index of each token
+    tok_pos: jnp.ndarray,  # [T] int32 kv position of each token (-1 = pad)
+    kv_lens: jnp.ndarray,  # [B] context length incl. each seq's new tokens
+    page_size: int,
+) -> jnp.ndarray:
+    """Ragged mixed-batch attention, materializing reference.
+
+    One flattened token stream holds ANY mix of variable-length prefill
+    spans and single decode tokens; each token attends causally over its
+    own sequence's paged context (positions <= its kv position). The
+    semantic twin of the Pallas ragged kernel
+    (ops/pallas/ragged_attention.py) and the ground truth the blockwise
+    serving path below is tested against. Padding tokens (tok_pos < 0)
+    produce garbage rows the caller must ignore.
+    """
+    T, H, hd = q.shape
+    B, max_pages = page_table.shape
+    L = max_pages * page_size
+    rows = page_table[jnp.clip(tok_seq, 0, B - 1)]  # [T, max_pages]
+    positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (T, L))
+    slots = flat_slot_indices(rows, positions, page_size)  # [T, L]
+    k = k_cache[slots]  # [T, L, Hk, hd]
+    v = v_cache[slots]
+    n_rep = H // k.shape[2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+    logits = jnp.einsum(
+        "thd,tlhd->thl", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale  # [T, H, L]
+    causal = positions <= tok_pos[:, None]  # [T, L]
+    in_seq = positions < kv_lens[jnp.clip(tok_seq, 0, B - 1)][:, None]
+    mask = (causal & in_seq)[:, None, :]
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("thl,tlhd->thd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def ragged_paged_attention_blockwise(
+    q: jnp.ndarray,  # [T, H, hd] flattened mixed-batch query stream
+    k_cache: jnp.ndarray,  # [S, Hk, hd] flat slot pool for ONE layer
+    v_cache: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, max_pages]
+    tok_seq: jnp.ndarray,  # [T] int32 sequence index of each token
+    tok_pos: jnp.ndarray,  # [T] int32 kv position of each token (-1 = pad)
+    kv_lens: jnp.ndarray,  # [B]
+    page_size: int,
+    block_pages: int = 8,
+) -> jnp.ndarray:
+    """Non-materializing ragged attention: the jnp serving path.
+
+    Walks the paged context in blocks of `block_pages` pages with an
+    online (flash-style) softmax; the loop trip count is DYNAMIC —
+    bounded by the deepest causal frontier in the batch — so HBM reads
+    scale with the actual context, not the padded maximum. Numerics
+    match ragged_paged_attention (same f32 online softmax; pinned in
+    tests/test_ragged_attention.py)."""
+    T, H, hd = q.shape
+    B, max_pages = page_table.shape
+    Hk = k_cache.shape[1]
+    n_rep = H // Hk
+    BLK = block_pages * page_size
+    n_blocks = -(-max_pages // block_pages)  # static ceiling
+    rows = page_table[jnp.clip(tok_seq, 0, B - 1)]  # [T, max_pages]
+    end = tok_pos + 1  # per-token causal frontier (0 for padding)
+    needed = jnp.max(-(-jnp.maximum(end, 0) // BLK))
+
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+    qf = q.astype(jnp.float32) * scale  # [T, H, hd]
+
+    def body(i, carry):
+        m, l, acc = carry
+        pidx = jnp.clip(
+            i * block_pages + jnp.arange(block_pages), 0, max_pages - 1
+        )
+        pages = rows[:, pidx]  # [T, block_pages]
+        pos = i * BLK + jnp.arange(BLK, dtype=jnp.int32)
+        slots = (pages[:, :, None] * page_size
+                 + jnp.arange(page_size)[None, None, :]).reshape(T, BLK)
+        k = repeat_kv(k_cache[slots].astype(jnp.float32), n_rep)  # [T,BLK,H,hd]
+        v = repeat_kv(v_cache[slots].astype(jnp.float32), n_rep)
+        logits = jnp.einsum("thd,tlhd->thl", qf, k)  # [T, H, BLK]
+        keep = (pos[None, :] <= tok_pos[:, None]) \
+            & (pos[None, :] < end[:, None])  # [T, BLK]
+        logits = jnp.where(keep[:, None, :], logits, NEG_INF)
+        blk_m = jnp.max(logits, axis=-1)  # [T, H]
+        new_m = jnp.maximum(m, blk_m)
+        p = jnp.exp(logits - new_m[..., None])
+        p = jnp.where(logits <= NEG_INF / 2, 0.0, p)
+        corr = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - new_m))
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum("thl,tlhd->thd", p, v)
+        return new_m, l, acc
+
+    m0 = jnp.full((T, H), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((T, H), jnp.float32)
+    a0 = jnp.zeros((T, H, hd), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(
+        0, jnp.minimum(needed, n_blocks), body, (m0, l0, a0)
+    )
+    out = acc / jnp.maximum(l, 1e-30)[..., None]  # [T, H, hd]
+    return out.astype(q.dtype)
+
+
+def ragged_attention_any(
+    attn_impl: str,
+    q: jnp.ndarray,  # [T, H, hd]
+    k_cache: jnp.ndarray,  # [S, Hk, hd] ONE layer's slot pool
+    v_cache: jnp.ndarray,
+    page_table: jnp.ndarray,  # [B, max_pages]
+    tok_seq: jnp.ndarray,  # [T] (jnp path metadata)
+    tok_pos: jnp.ndarray,  # [T]
+    kv_lens: jnp.ndarray,  # [B]
+    q_start: jnp.ndarray,  # [B] (pallas path metadata)
+    q_lens: jnp.ndarray,  # [B]
+    page_size: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """The ONE pallas-vs-jnp ragged-attention dispatch (mirror of
+    paged_decode_attention_any), shared by models/llama.forward_ragged so
+    the two paths cannot drift. Both metadata encodings travel together:
+    per-token (tok_seq/tok_pos) feeds the jnp gather path, per-sequence
+    (q_start/q_lens) rides the Pallas kernel's scalar prefetch."""
+    if attn_impl == "pallas":
+        from ollamamq_tpu.ops.pallas.ragged_attention import (
+            ragged_paged_attention_pallas,
+        )
+
+        return ragged_paged_attention_pallas(
+            q, k_cache, v_cache, page_table, q_start, q_lens, kv_lens,
+            page_size, interpret=interpret,
+        )
+    return ragged_paged_attention_blockwise(
+        q, k_cache, v_cache, page_table, tok_seq, tok_pos, kv_lens, page_size
+    )
+
+
 def paged_decode_attention_any(
     attn_impl: str,
     q: jnp.ndarray,  # [B, H, hd]

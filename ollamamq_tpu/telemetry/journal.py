@@ -74,8 +74,14 @@ EVENT_FIELDS: Dict[str, Tuple[tuple, tuple]] = {
     "place": (("runtime",), ()),
     "shed": (("reason",),
              ("queued", "limit", "retry_after_s", "n_prompt", "max_tokens")),
-    "batch": (("slots", "bucket", "batch_size", "tokens", "occupancy"),
-              ("reqs", "pending", "free_pages")),
+    # `mode` tells the two batch shapes apart: "bucketed" records carry
+    # the bucket they padded to; "ragged" records carry the granule-
+    # padded stream total plus its prefill/decode row split. Both carry
+    # real vs padded token counts, which batch_stats() below turns into
+    # the padding-waste scoreboard.
+    "batch": (("slots", "batch_size", "tokens", "occupancy"),
+              ("reqs", "pending", "free_pages", "bucket", "mode",
+               "padded_tokens", "n_prefill", "n_decode")),
     "chunk": (("slot", "pos"), ("tokens", "cached")),
     "install": (("slot",), ("n_prompt",)),
     "preempt": (("slot", "why"),
@@ -313,6 +319,13 @@ def explain(rec: dict) -> str:
         return ": ".join([parts[0], ", ".join(parts[1:])]) if parts[1:] \
             else parts[0]
     if kind == "batch":
+        if rec.get("mode") == "ragged" or "bucket" not in rec:
+            return (f"ragged batch on {rec.get('model', '?')}: "
+                    f"{rec.get('n_prefill', '?')} prefill span(s) + "
+                    f"{rec.get('n_decode', '?')} decode row(s), "
+                    f"{rec.get('tokens', '?')}/"
+                    f"{rec.get('padded_tokens', '?')} real/padded tokens, "
+                    f"occupancy {rec.get('occupancy', 0):.2f}")
         return (f"prefill batch on {rec.get('model', '?')}: "
                 f"{len(rec.get('slots', []))} req(s) in bucket "
                 f"{rec.get('bucket', '?')} (B={rec.get('batch_size', '?')}, "
@@ -473,27 +486,49 @@ def check_invariants(records: List[dict],
 # (bench.py folds this into the BENCH JSON line).
 # ---------------------------------------------------------------------------
 
+def _padded_of(rec: dict) -> int:
+    """Dispatched token positions of one batch record: the explicit
+    padded total (ragged + new bucketed records) or bucket x rows
+    (records spilled before the field existed)."""
+    if rec.get("padded_tokens") is not None:
+        return int(rec["padded_tokens"])
+    return int(rec.get("bucket", 0)) * int(rec.get("batch_size", 0))
+
+
 def batch_stats(records: List[dict]) -> dict:
     """Occupancy and padding-waste summary over `batch` records.
 
-    padding_waste = fraction of dispatched prefill token positions
-    (bucket x batch rows) that were padding, the compute the bucketing
-    scheme burned for shape stability."""
+    padding_waste = fraction of dispatched token positions that were
+    padding — power-of-two bucket rows on the bucketed path, the granule
+    tail on the ragged path: the compute burned for shape stability.
+    Per-mode rows break the two shapes apart when a journal holds both."""
     batches = [r for r in records if r.get("kind") == "batch"]
     if not batches:
         return {"batches": 0, "mean_occupancy": 0.0,
                 "padding_waste": 0.0, "real_tokens": 0, "padded_tokens": 0}
     occ = sum(r.get("occupancy", 0.0) for r in batches) / len(batches)
     real = sum(int(r.get("tokens", 0)) for r in batches)
-    padded = sum(int(r.get("bucket", 0)) * int(r.get("batch_size", 0))
-                 for r in batches)
-    return {
+    padded = sum(_padded_of(r) for r in batches)
+    out = {
         "batches": len(batches),
         "mean_occupancy": round(occ, 4),
         "padding_waste": round(1.0 - real / padded, 4) if padded else 0.0,
         "real_tokens": real,
         "padded_tokens": padded,
     }
+    modes = sorted({r.get("mode", "bucketed") for r in batches})
+    if len(modes) > 1:
+        out["modes"] = {}
+        for mode in modes:
+            ms = [r for r in batches if r.get("mode", "bucketed") == mode]
+            mreal = sum(int(r.get("tokens", 0)) for r in ms)
+            mpad = sum(_padded_of(r) for r in ms)
+            out["modes"][mode] = {
+                "batches": len(ms),
+                "padding_waste": (round(1.0 - mreal / mpad, 4)
+                                  if mpad else 0.0),
+            }
+    return out
 
 
 def fair_share_audit(records: List[dict]) -> dict:

@@ -39,6 +39,12 @@ BATCH_OCCUPANCY = REGISTRY.gauge(
     "ollamamq_batch_occupancy",
     "Active decode slots / max_slots (0..1), sampled per decode step",
     labels=("model",))
+BATCH_PADDING_WASTE = REGISTRY.gauge(
+    "ollamamq_batch_padding_waste",
+    "Fraction of the last dispatched batch's token positions that were "
+    "padding (0..1): bucket rows minus real tokens on the bucketed path, "
+    "the granule tail on the ragged path — the compute burned for shape "
+    "stability", labels=("model",))
 KV_PAGES_USED = REGISTRY.gauge(
     "ollamamq_kv_pages_used",
     "KV cache pages currently allocated", labels=("model",))

@@ -304,7 +304,9 @@ def test_deadline_header_rides_the_http_surface():
 def test_injected_prefill_fault_retries_and_succeeds():
     from ollamamq_tpu.telemetry import schema as tm
 
-    plan = FaultPlan([{"site": "prefill", "kind": "exception", "at": [1]}])
+    # "ragged" is the default mode's prefill-path dispatch site (the
+    # mixed token-budget dispatch replaced batched prefill).
+    plan = FaultPlan([{"site": "ragged", "kind": "exception", "at": [1]}])
     eng = _tpu_engine(plan=plan)
     try:
         req = _run(eng, "u")
@@ -325,7 +327,7 @@ def test_repeated_fault_poisons_engine_keeps_serving():
     """Two consecutive injected prefill faults exhaust the retry budget:
     the request is poisoned with an explicit error, and the NEXT request
     (fault plan spent) serves normally — no crash loop."""
-    plan = FaultPlan([{"site": "prefill", "kind": "exception", "at": [1, 2]}])
+    plan = FaultPlan([{"site": "ragged", "kind": "exception", "at": [1, 2]}])
     eng = _tpu_engine(plan=plan)
     try:
         poisoned = collect(_run(eng, "bad"), timeout=60)

@@ -1,0 +1,258 @@
+"""Ragged token-budget batch composition vs the bucketed oracle.
+
+The load-bearing guarantees pinned here:
+  - ragged vs bucketed greedy token streams are BYTE-IDENTICAL across a
+    randomized mix of prompt lengths straddling the old bucket
+    boundaries, with the prefix cache off AND on, repeat-penalty
+    requests included, and a request cancelled mid-prefill;
+  - the journal's batch records on the ragged path report padding waste
+    <= 0.10 under a synthetic overload (seed baseline on the bucketed
+    path: 0.56) with occupancy above the 0.43 baseline — the regression
+    gate for the padding tax this PR kills;
+  - _bucket_for REFUSES oversize pieces instead of silently answering
+    the largest bucket (satellite: the oracle path can't mask a packing
+    bug);
+  - a faulted ragged dispatch retries its implicated requests (prefill
+    spans AND decode rows) and the streams still finish byte-identical.
+"""
+
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ollamamq_tpu.config import MODEL_CONFIGS, EngineConfig
+from ollamamq_tpu.core import MQCore
+from ollamamq_tpu.engine.engine import ModelRuntime
+from ollamamq_tpu.engine.request import Request
+from ollamamq_tpu.ops.sampling import SamplingParams
+from ollamamq_tpu.telemetry.journal import (Journal, batch_stats,
+                                            check_invariants)
+from ollamamq_tpu.testing.faults import FaultPlan
+
+_IDS = itertools.count(1)
+
+PS = 8
+BUCKETS = (16, 64)  # boundaries the fuzz prompts straddle
+
+
+def make_rt(mode, **kw):
+    defaults = dict(
+        model="test-tiny", max_slots=4, num_pages=96, page_size=PS,
+        max_pages_per_seq=16, prefill_buckets=BUCKETS, max_new_tokens=8,
+        decode_steps_per_iter=2, attention_mode=mode,
+        max_batch_tokens=48, token_granule=8,
+    )
+    defaults.update(kw)
+    rt = ModelRuntime("test-tiny", MODEL_CONFIGS["test-tiny"],
+                      EngineConfig(**defaults), dtype=jnp.float32)
+    rt.tokenizer.eos_id = -1  # deterministic full-length streams
+    return rt
+
+
+def tick(rt, core):
+    """One engine-loop-shaped tick for either mode."""
+    if rt.ragged:
+        ran = rt.step_ragged(core)
+        if not ran and any(r is not None for r in rt.slot_req):
+            rt.step_decode(core, k_steps=1)
+    else:
+        rt.step_prefill(core)
+        rt.step_chunk(core)
+        if any(r is not None for r in rt.slot_req):
+            rt.step_decode(core, k_steps=1)
+
+
+def run_all(rt, prompts, max_tokens=6, repeat_penalty=1.0,
+            cancel_mid_prefill=None, max_ticks=800):
+    """Drive a batch of prompts to completion; returns each request's
+    generated ids (None for a cancelled one). `cancel_mid_prefill`
+    names a request index to cancel as soon as its prefill is
+    partially done (0 < _chunk_pos < n in either mode)."""
+    core = MQCore(None)
+    reqs = []
+    for p in prompts:
+        req = Request(next(_IDS), f"u{len(reqs) % 3}", "test-tiny", list(p),
+                      SamplingParams(max_tokens=max_tokens,
+                                     repeat_penalty=repeat_penalty))
+        req._inc_decode = rt.tokenizer.make_incremental_decoder()
+        rt.pending_prefill.append(req)
+        reqs.append(req)
+    victim = (reqs[cancel_mid_prefill]
+              if cancel_mid_prefill is not None else None)
+    for _ in range(max_ticks):
+        if victim is not None and not victim.cancelled.is_set():
+            pos = getattr(victim, "_chunk_pos", 0)
+            if 0 < pos < len(victim.prompt_tokens):
+                victim.cancelled.set()
+        if all(r.stats.finished_at for r in reqs):
+            break
+        tick(rt, core)
+    assert all(r.stats.finished_at for r in reqs), "requests wedged"
+    return [None if r is victim else list(r.generated_ids) for r in reqs]
+
+
+def _fuzz_prompts(rng, n):
+    """Prompt lengths hugging/straddling the bucket boundaries plus a
+    few randoms — the shapes the bucketed composer split into separate
+    batches and the ragged composer must pack together."""
+    straddle = [b + d for b in BUCKETS for d in (-1, 0, 1)]
+    lens = [straddle[int(rng.integers(len(straddle)))]
+            if rng.random() < 0.6 else int(rng.integers(2, 80))
+            for _ in range(n)]
+    return [rng.integers(3, 500, size=max(1, L)).tolist() for L in lens]
+
+
+@pytest.mark.parametrize("repeat_penalty", [1.0, 1.1],
+                         ids=["greedy", "repeat-penalty"])
+def test_ragged_matches_bucketed_byte_identical(repeat_penalty):
+    rng = np.random.default_rng(11)
+    for round_ in range(3):
+        prompts = _fuzz_prompts(rng, 6)
+        a = run_all(make_rt("bucketed"), prompts,
+                    repeat_penalty=repeat_penalty)
+        b = run_all(make_rt("ragged"), prompts,
+                    repeat_penalty=repeat_penalty)
+        assert a == b, f"round {round_}: streams diverged"
+
+
+@pytest.mark.parametrize("prefix_cache", [False, True],
+                         ids=["cache-off", "cache-on"])
+def test_ragged_matches_bucketed_with_prefix_cache(prefix_cache):
+    rng = np.random.default_rng(7)
+    shared = rng.integers(3, 500, size=3 * PS).tolist()
+    prompts = [shared + rng.integers(3, 500, size=t).tolist()
+               for t in (5, 17, 40)] + _fuzz_prompts(rng, 2)
+    a = run_all(make_rt("bucketed", prefix_cache=prefix_cache), prompts)
+    b = run_all(make_rt("ragged", prefix_cache=prefix_cache), prompts)
+    assert a == b
+
+
+def test_mid_prefill_cancel_leaves_survivors_identical():
+    """Cancelling a long prompt mid-prefill (its spans already dispatched)
+    must not perturb the other requests' streams in either mode, and the
+    cancelled slot's pages must all return to the pool."""
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(3, 500, size=n).tolist()
+               for n in (70, 15, 33)]  # 70 > largest bucket: chunks in both
+    rts = {mode: make_rt(mode) for mode in ("bucketed", "ragged")}
+    outs = {mode: run_all(rt, prompts, cancel_mid_prefill=0)
+            for mode, rt in rts.items()}
+    assert outs["ragged"] == outs["bucketed"]
+    assert outs["ragged"][0] is None
+    for rt in rts.values():
+        assert rt.alloc.used_pages == 0
+        assert not rt.reserved_slots and not rt.chunking
+
+
+def test_bucket_for_refuses_oversize():
+    rt = make_rt("bucketed")
+    assert rt._bucket_for(16) == 16
+    assert rt._bucket_for(17) == 64
+    with pytest.raises(ValueError):
+        rt._bucket_for(BUCKETS[-1] + 1)
+
+
+def test_ragged_dispatch_fault_retries_and_streams_survive():
+    """An injected exception in the mixed dispatch retries BOTH its
+    prefill spans and its decode rows (replay semantics): every stream
+    still completes, byte-identical to an unfaulted run."""
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(3, 500, size=n).tolist() for n in (20, 7, 35)]
+    clean = run_all(make_rt("ragged"), prompts)
+    # The 2nd mixed dispatch carries a prefill tail AND live decode rows,
+    # so the containment path must replay both kinds.
+    plan = FaultPlan([{"site": "ragged", "kind": "exception", "at": [2]}])
+    rt = make_rt("ragged", retry_backoff_s=0.0)
+    rt.fault_plan = plan
+    faulted = run_all(rt, prompts)
+    assert plan.injected == 1
+    assert faulted == clean
+    assert rt.retry_count >= 1
+
+
+# ------------------------------------------------ padding-waste regression
+def _overload_trace(mode, n_requests=24, seed=5):
+    """Synthetic overload: arrivals outpace the drain so composition
+    always has a backlog to pack; returns the journal's batch stats."""
+    rng = np.random.default_rng(seed)
+    rt = make_rt(mode, max_slots=4, num_pages=160,
+                 max_batch_tokens=64, token_granule=8)
+    journal = Journal(capacity=65536)
+    rt.journal = journal
+    core = MQCore(None)
+    reqs = []
+    issued = 0
+    guard = 0
+    while True:
+        while issued < n_requests and len(rt.pending_prefill) < 6:
+            n = int(rng.integers(5, 70))
+            req = Request(next(_IDS), f"ov{issued % 4}", "test-tiny",
+                          rng.integers(3, 500, size=n).tolist(),
+                          SamplingParams(max_tokens=4))
+            req._inc_decode = rt.tokenizer.make_incremental_decoder()
+            rt.pending_prefill.append(req)
+            reqs.append(req)
+            issued += 1
+        tick(rt, core)
+        if issued >= n_requests and all(r.stats.finished_at for r in reqs):
+            break
+        guard += 1
+        assert guard < 5000, "overload trace wedged"
+    recs = journal.tail(None)
+    assert not check_invariants(recs)
+    return batch_stats(recs)
+
+
+def test_padding_waste_gate_ragged():
+    """CI gate: the ragged path's padding waste must stay <= 0.10 under
+    overload (seed baseline on the bucketed path: 0.56), with batch
+    occupancy strictly above the 0.43 baseline."""
+    stats = _overload_trace("ragged")
+    assert stats["batches"] > 0
+    assert stats["padding_waste"] <= 0.10, stats
+    assert stats["mean_occupancy"] > 0.43, stats
+
+
+def test_padding_waste_bucketed_baseline_still_measured():
+    """The oracle path keeps reporting its (worse) padding waste — the
+    scoreboard both modes are judged on stays comparable."""
+    stats = _overload_trace("bucketed")
+    assert stats["batches"] > 0
+    assert stats["padded_tokens"] >= stats["real_tokens"]
+    assert stats["padding_waste"] > 0.10, stats  # the tax ragged kills
+
+
+def test_ragged_batch_records_carry_the_split():
+    """Every ragged batch record carries mode/padded_tokens and the
+    prefill/decode row split the schema promises."""
+    rng = np.random.default_rng(2)
+    rt = make_rt("ragged")
+    journal = Journal(capacity=4096)
+    rt.journal = journal
+    core = MQCore(None)
+    run_all_rt(rt, core, rng)
+    recs = journal.tail(None, kind="batch")
+    assert recs, "no batch records journaled"
+    for r in recs:
+        assert r["mode"] == "ragged"
+        assert r["padded_tokens"] >= r["tokens"]
+        assert r["n_prefill"] + r["n_decode"] == r["batch_size"]
+        assert r["padded_tokens"] % 8 == 0  # the granule
+
+
+def run_all_rt(rt, core, rng):
+    reqs = []
+    for n in (20, 5, 33):
+        req = Request(next(_IDS), "u", "test-tiny",
+                      rng.integers(3, 500, size=n).tolist(),
+                      SamplingParams(max_tokens=4))
+        req._inc_decode = rt.tokenizer.make_incremental_decoder()
+        rt.pending_prefill.append(req)
+        reqs.append(req)
+    for _ in range(400):
+        if all(r.stats.finished_at for r in reqs):
+            return
+        tick(rt, core)
+    raise AssertionError("requests wedged")
