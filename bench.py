@@ -50,6 +50,7 @@ def _fallback_argv(model: str, attention: str = "ragged",
            "--steps", "16", "--warmup-steps", "4", "--chunk", "4",
            "--ttft-samples", "2", "--sweep-chunks", "",
            "--attention", attention,
+           "--speculative", "3",
            "--shared-prefix", "2", "--shared-prefix-len", "64",
            "--shared-prefix-tail", "16",
            "--slo-burst", "2", "--slo-burst-size", "4",
@@ -174,6 +175,19 @@ def main() -> int:
                    help="ragged dispatch token budget")
     p.add_argument("--token-granule", type=int, default=16,
                    help="ragged stream-total padding granule")
+    p.add_argument("--spec", action="store_true",
+                   help="enable speculative decoding in the engine config "
+                        "under test (n-gram drafts + ragged verify); every "
+                        "BENCH record carries this field next to "
+                        "'attention' so A/B rounds are attributable")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="max draft tokens per decode slot per dispatch")
+    p.add_argument("--speculative", type=int, default=4,
+                   help="requests in the speculative scenario (spec-off vs "
+                        "spec-on decode throughput on a repetitive "
+                        "generation regime + accept-rate/throttle readout "
+                        "on a non-repetitive one; reports byte-identity "
+                        "and rollback counts); 0 disables")
     p.add_argument("--sampled", action="store_true",
                    help="use Ollama-default sampling (temp 0.8, repeat 1.1) "
                         "instead of greedy — exercises the full sampler")
@@ -291,7 +305,7 @@ def main() -> int:
                                               args.attention):
                     os._exit(exit_code)
                 _emit_error(msg, phase=phase, attention=args.attention,
-                            **extras)
+                            spec=args.spec, **extras)
                 os._exit(exit_code)
 
         threading.Thread(target=w, daemon=True).start()
@@ -307,7 +321,8 @@ def main() -> int:
         msg = f"backend init failed: {type(e).__name__}: {e}"
         if _any_fallback(args.model, msg, args.attention):
             return 3
-        _emit_error(msg, phase="init", attention=args.attention)
+        _emit_error(msg, phase="init", attention=args.attention,
+                    spec=args.spec)
         return 3
     # Pages: prompt + generated headroom for every slot. A leg consumes,
     # beyond prompt + steps: one compile dispatch (chunk), timed_decode's
@@ -334,6 +349,8 @@ def main() -> int:
         attention_mode=args.attention,
         max_batch_tokens=args.max_batch_tokens,
         token_granule=args.token_granule,
+        spec=args.spec,
+        spec_k=args.spec_k,
     )
     core = MQCore(None)
     t0 = time.monotonic()
@@ -344,7 +361,7 @@ def main() -> int:
         if _any_fallback(args.model, msg, args.attention):
             return 4
         _emit_error(msg, phase="runtime_init", device=str(dev),
-                    attention=args.attention)
+                    attention=args.attention, spec=args.spec)
         return 4
     finally:
         init_done.set()  # watchdog covers device + runtime init, not the run
@@ -640,6 +657,19 @@ def main() -> int:
             rt.fault_plan = None
             rt.on_preempt = None
 
+    # speculative scenario: spec-off vs spec-on decode throughput on a
+    # repetitive generation regime (where n-gram drafts verify), plus an
+    # accept-rate/auto-throttle readout on the chaotic regime — with the
+    # byte-identity of the two legs' streams checked in-band.
+    speculative = None
+    if args.speculative > 0:
+        try:
+            speculative = _speculative_scenario(rt, core, args, rng, touch)
+        except Exception as e:  # never discard the decode numbers
+            speculative = {"error": f"{type(e).__name__}: {e}"}
+            print(f"# speculative scenario failed: {speculative['error']}",
+                  file=sys.stderr)
+
     # slo_burst scenario: bursty arrivals against a TTFT objective —
     # where does the burst's latency actually go (queue vs prefill), and
     # how fast does it burn the error budget? Anchors the SLO/attribution
@@ -665,6 +695,9 @@ def main() -> int:
         # batch-composition mode here ride EVERY record (incl. error and
         # fallback lines), so official rounds are attributable.
         "attention": args.attention,
+        # Speculative decoding on/off in the engine config under test;
+        # the `speculative` scenario below reports its own A/B legs.
+        "spec": bool(args.spec),
         "telemetry": telemetry,
         "hbm_gbps_est": round(hbm_gbps, 1),
         "mfu_pct_est": round(mfu_pct, 2),
@@ -694,6 +727,8 @@ def main() -> int:
             result["embed_error"] = embed_error
     if shared_prefix is not None:
         result["shared_prefix"] = shared_prefix
+    if speculative is not None:
+        result["speculative"] = speculative
     if slo_burst is not None:
         result["slo_burst"] = slo_burst
     if overload is not None:
@@ -887,6 +922,210 @@ def _overload_scenario(rt, core, args, rng, touch):
         "ttft_p99_ms": (round(ttfts[min(served - 1,
                                         int(0.99 * served))], 1)
                         if served else None),
+        "silent_truncations": silent_truncations,
+    }
+
+
+def _speculative_scenario(rt, core, args, rng, touch):
+    """Speculative-decoding acceptance: the same prompt mix driven
+    spec-off then spec-on at the same seed, on the serving-path tick
+    shape (one mixed/decode dispatch per tick — the regime the ISSUE
+    targets, where decode tok/s is bounded by dispatch rate).
+
+    Two generation regimes, because draft accept rate is a property of
+    what the model GENERATES, not of the engine: random weights produce
+    chaotic streams no lookup can predict, so the "repetitive" leg
+    rebuilds the same architecture as a deterministic copy map (residual
+    output projections zeroed => next token is a pure function of the
+    last => generation enters a cycle, exactly the regime real LMs hit
+    on repetitive text) and measures spec-on vs spec-off tok/s there;
+    the "non_repetitive" leg keeps the real random weights and reports
+    the accept rate and whether the per-user auto-throttle engaged.
+    Both legs assert byte-identical streams — `identical` and
+    `silent_truncations` land in the record."""
+    import time
+
+    import jax.numpy as jnp
+
+    from ollamamq_tpu.engine.request import FinishReason, Request
+    from ollamamq_tpu.ops.sampling import SamplingParams
+
+    if not getattr(rt, "ragged", False):
+        return {"skipped": "speculation needs --attention=ragged"}
+    n_req = min(args.speculative, args.slots)
+    # Floor high enough that the spec-on leg sees several STEADY verify
+    # dispatches after its compile ticks are excluded — a 2-tick sample
+    # is noise, not a measurement.
+    max_new = max(24, min(48, args.steps))
+    prompt_len = min(args.prompt_len, 48)
+    hi = min(rt.cfg.vocab_size, 30000)
+
+    def drain():
+        for s, r in enumerate(rt.slot_req):
+            if r is not None:
+                rt._finish_slot(s, FinishReason.CANCELLED, core)
+
+    def make_prompts(repetitive):
+        out = []
+        for i in range(n_req):
+            if repetitive and i % 2 == 0:
+                pat = rng.integers(3, hi, size=6).tolist()
+                out.append((pat * ((prompt_len // 6) + 1))[:prompt_len])
+            else:
+                out.append(rng.integers(3, hi, size=prompt_len).tolist())
+        return out
+
+    def copy_map_cycle(start, budget=128):
+        """The copy model's next-token map is context-free (next =
+        argmax(logits(embed[last]))), so its cycle is computable
+        off-engine: iterate the map until a token repeats. Prompts tiled
+        from the cycle make generation predictable from the FIRST decode
+        tick — the repetitive regime at full strength even on a short
+        smoke run. One probe step is a full-vocab logit row (heavy on a
+        big CPU-smoke model), so the walk is budgeted and probed ONCE;
+        an unclosed walk degrades to its tail (lower accept, reported
+        honestly)."""
+        from ollamamq_tpu.models import llama as llm
+
+        seen, seq, t = {}, [], int(start)
+        for _ in range(budget):
+            if t in seen:
+                return seq[seen[t]:]
+            seen[t] = len(seq)
+            seq.append(t)
+            x = rt.params["embed"][t][None, None, :]
+            t = int(jnp.argmax(llm._logits(rt.params, rt.cfg, x)[0, 0]))
+        return seq[-16:]
+
+    def cycle_prompts():
+        # One probe, rotated per request: any rotation of a cycle is
+        # still map-consecutive, so every prompt stays predictable.
+        cyc = copy_map_cycle(int(rng.integers(3, hi)))
+        out = []
+        for i in range(n_req):
+            rot = cyc[i % len(cyc):] + cyc[:i % len(cyc)]
+            out.append((rot * (prompt_len // len(rot) + 2))[:prompt_len])
+        return out
+
+    def run_leg(prompts, spec_on, idx0, new_tokens=None):
+        """Drive one A/B leg on the serving-path tick shape. Throughput
+        is computed over STEADY-STATE ticks only: a tick that grew the
+        jit cache paid a compile, and counting it would bill one leg
+        for one-time cost the other never sees — this is also what
+        makes the scenario affordable on slow backends (no separate
+        full-length warmup leg per mode)."""
+        drain()
+        rt.spec = spec_on
+        rt._spec_user.clear()
+        rt._spec_throttled.clear()
+        p0, a0, r0 = rt.spec_proposed, rt.spec_accepted, rt.spec_rollbacks
+        reqs = []
+        for i, p in enumerate(prompts):
+            req = Request(50000 + idx0 + i, f"spec{i}", rt.name, list(p),
+                          SamplingParams(max_tokens=new_tokens or max_new))
+            req._inc_decode = rt.tokenizer.make_incremental_decoder()
+            rt.pending_prefill.append(req)
+            reqs.append(req)
+        ticks = 0
+        steady_s, steady_tokens, gen_prev = 0.0, 0, 0
+        while not all(r.stats.finished_at for r in reqs):
+            jits0 = len(rt._prefill_jits) + len(rt._decode_jits)
+            t0 = time.monotonic()
+            progressed = rt.step_ragged(core)
+            if not progressed and any(r is not None for r in rt.slot_req):
+                progressed = rt.step_decode(core, k_steps=1) > 0
+            dt = time.monotonic() - t0
+            touch("speculative")
+            ticks += 1
+            gen_now = sum(len(r.generated_ids) for r in reqs)
+            if len(rt._prefill_jits) + len(rt._decode_jits) == jits0:
+                steady_s += dt
+                steady_tokens += gen_now - gen_prev
+            gen_prev = gen_now
+            if ticks > 4000 * max(1, n_req):
+                raise RuntimeError("speculative leg wedged")
+        return {
+            "streams": [list(r.generated_ids) for r in reqs],
+            "tok_s": (round(steady_tokens / steady_s, 1)
+                      if steady_s > 0 else 0.0),
+            "ticks": ticks,
+            "proposed": rt.spec_proposed - p0,
+            "accepted": rt.spec_accepted - a0,
+            "rollbacks": rt.spec_rollbacks - r0,
+            "throttled_users": len(rt._spec_throttled),
+        }
+
+    spec0, k0, min0 = rt.spec, rt.ecfg.spec_k, rt.ecfg.spec_min_accept
+    eos0 = rt.tokenizer.eos_id
+    layers = rt.params["layers"]
+    orig_wo, orig_wd = layers["wo"], layers["w_down"]
+    rt.ecfg.spec_k = args.spec_k
+    rt.tokenizer.eos_id = -1  # full-length streams: compare whole outputs
+    silent_truncations = 0
+    try:
+        # Repetitive regime: deterministic copy map (see docstring),
+        # prompts tiled from the map's own cycle so drafts verify from
+        # the first decode tick. One untimed warmup leg per mode first:
+        # each leg's jit variants must be compiled before the A/B is
+        # timed, or the first leg pays compile time the second doesn't.
+        layers["wo"] = jnp.zeros_like(orig_wo)
+        layers["w_down"] = jnp.zeros_like(orig_wd)
+        rt.ecfg.spec_min_accept = 0.0  # measuring, not throttling
+        rep_prompts = cycle_prompts()
+        rep_off = run_leg(rep_prompts, spec_on=False, idx0=0)
+        rep_on = run_leg(rep_prompts, spec_on=True, idx0=1000)
+        rep_identical = rep_off["streams"] == rep_on["streams"]
+        for leg in (rep_off, rep_on):
+            silent_truncations += sum(
+                1 for s in leg.pop("streams") if len(s) < max_new)
+        # Chaotic regime: real weights, default throttle — what accept
+        # rate does prompt-lookup actually get, and does the throttle
+        # stop paying for hopeless users? (Accept-rate readout only;
+        # spec-on/off byte-identity across regimes is pinned by tier-1
+        # tests/test_spec_decoding.py, so no off-baseline leg is spent
+        # here — the CPU-smoke budget is tight on a 1B model.)
+        layers["wo"], layers["w_down"] = orig_wo, orig_wd
+        rt.ecfg.spec_min_accept = 0.1
+        chaos_new = max(8, max_new // 2)  # readout leg: keep it cheap
+        chaos_on = run_leg(make_prompts(repetitive=False), spec_on=True,
+                           idx0=3000, new_tokens=chaos_new)
+        silent_truncations += sum(
+            1 for s in chaos_on.pop("streams") if len(s) < chaos_new)
+    finally:
+        layers["wo"], layers["w_down"] = orig_wo, orig_wd
+        rt.spec = spec0
+        rt.ecfg.spec_k = k0
+        rt.ecfg.spec_min_accept = min0
+        rt.tokenizer.eos_id = eos0
+        rt._spec_user.clear()
+        rt._spec_throttled.clear()
+        drain()
+    prop = max(1, rep_on["proposed"])
+    cprop = max(1, chaos_on["proposed"])
+    return {
+        "requests": n_req,
+        "max_new": max_new,
+        "spec_k": args.spec_k,
+        "repetitive": {
+            "tok_s_spec_off": rep_off["tok_s"],
+            "tok_s_spec_on": rep_on["tok_s"],
+            "speedup": round(rep_on["tok_s"] / max(0.001,
+                                                   rep_off["tok_s"]), 2),
+            "ticks_off": rep_off["ticks"],
+            "ticks_on": rep_on["ticks"],
+            "proposed": rep_on["proposed"],
+            "accepted": rep_on["accepted"],
+            "accept_rate": round(rep_on["accepted"] / prop, 4),
+            "rollbacks": rep_on["rollbacks"],
+            "identical": rep_identical,
+        },
+        "non_repetitive": {
+            "proposed": chaos_on["proposed"],
+            "accepted": chaos_on["accepted"],
+            "accept_rate": round(chaos_on["accepted"] / cprop, 4),
+            "rollbacks": chaos_on["rollbacks"],
+            "throttled_users": chaos_on["throttled_users"],
+        },
         "silent_truncations": silent_truncations,
     }
 

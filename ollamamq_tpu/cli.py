@@ -68,6 +68,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ragged streams pad their TOTAL token count to "
                         "this granule (the only padding the ragged path "
                         "pays; one compile per padded total)")
+    p.add_argument("--spec", action="store_true", default=False,
+                   help="speculative multi-token decoding on the ragged "
+                        "path: n-gram prompt-lookup drafts (up to "
+                        "--spec-k per greedy decode slot) verified in "
+                        "one ragged dispatch; accepted drafts emit "
+                        "together, rejected drafts' KV pages roll back. "
+                        "Greedy streams stay byte-identical to --no-spec")
+    p.add_argument("--no-spec", dest="spec", action="store_false",
+                   help="disable speculative decoding (the default)")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="max draft tokens proposed per decode slot per "
+                        "dispatch")
+    p.add_argument("--spec-min-accept", type=float, default=0.1,
+                   help="per-user auto-throttle: once a user's observed "
+                        "draft accept rate falls below this (after a "
+                        "warmup sample), speculation is disabled for "
+                        "that user — wasted verify FLOPs must pay for "
+                        "themselves; 0 never throttles")
     p.add_argument("--prefix-cache", action="store_true",
                    help="automatic prefix caching: share finished prompts' "
                         "KV pages (page-granular radix tree) across "
@@ -245,6 +263,9 @@ def main(argv=None) -> int:
     if args.token_granule < 1 or args.max_batch_tokens < 1:
         log.error("--token-granule / --max-batch-tokens must be >= 1")
         return 2
+    if args.spec_k < 1 or not (0.0 <= args.spec_min_accept <= 1.0):
+        log.error("--spec-k must be >= 1 and --spec-min-accept in [0, 1]")
+        return 2
     if args.journal_rotate_mb < 0 or args.log_rotate_mb < 0:
         log.error("--journal-rotate-mb / --log-rotate-mb must be >= 0 "
                   "(0 disables rotation)")
@@ -313,6 +334,9 @@ def main(argv=None) -> int:
         attention_mode=args.attention,
         max_batch_tokens=args.max_batch_tokens,
         token_granule=args.token_granule,
+        spec=args.spec,
+        spec_k=args.spec_k,
+        spec_min_accept=args.spec_min_accept,
         prefix_cache=args.prefix_cache,
         prefix_cache_min_pages=args.prefix_cache_min_pages,
         dp=args.dp,

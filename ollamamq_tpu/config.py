@@ -228,6 +228,20 @@ class EngineConfig:
     # count rounds up to this granule for shape stability (one compile
     # per padded total). Small => waste bounded by granule/batch_tokens.
     token_granule: int = 16
+    # -- speculative multi-token decoding (ragged path) ----------------------
+    # Propose up to spec_k draft tokens per greedy decode slot from an
+    # n-gram prompt/history lookup (no second model), then verify them
+    # all in ONE ragged dispatch as a (k+1)-token span: accepted drafts
+    # emit together (the longest prefix where draft == argmax, plus the
+    # model's own next token — byte-identical to non-speculative greedy),
+    # rejected drafts' KV pages roll back. Greedy no-penalty requests
+    # only; sampled/penalized rows stay 1-token decode rows.
+    spec: bool = False
+    spec_k: int = 4
+    # Auto-throttle: once a user's observed accept rate over a warmup
+    # sample falls below this, speculation is disabled for that user —
+    # wasted verify FLOPs must pay for themselves. 0 = never throttle.
+    spec_min_accept: float = 0.1
     # Max new tokens default when request doesn't specify.
     max_new_tokens: int = 256
     # Decode steps executed per host-loop iteration when no prefill pending

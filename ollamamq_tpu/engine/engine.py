@@ -45,8 +45,9 @@ from ollamamq_tpu.engine import kv_cache as kvc
 from ollamamq_tpu.engine.request import FinishReason, Request, StreamItem
 from ollamamq_tpu.engine.tokenizer import load_tokenizer
 from ollamamq_tpu.models import llama, weights
-from ollamamq_tpu.ops.sampling import (maybe_apply_penalties, per_row_keys,
-                                       sample_tokens_rowwise, sampling_flags)
+from ollamamq_tpu.ops.sampling import (accept_prefix, maybe_apply_penalties,
+                                       per_row_keys, sample_tokens_rowwise,
+                                       sampling_flags)
 from ollamamq_tpu.parallel import pipeline
 from ollamamq_tpu.parallel.mesh import (make_mesh, replica_submesh,
                                         validate_tp_for_model)
@@ -488,6 +489,31 @@ class ModelRuntime:
         ladder.append(self._ragged_budget)
         self._ragged_ladder = ladder
 
+        # Speculative decoding state (--spec): n-gram drafts verified on
+        # the ragged span path. Host-side accounting feeds the accept-
+        # rate gauge and the per-user auto-throttle; the actual accept/
+        # rollback machinery lives in _get_ragged_jit / step_ragged.
+        self.spec = bool(engine_cfg.spec) and self.ragged \
+            and engine_cfg.spec_k > 0
+        if engine_cfg.spec and not self.ragged:
+            log.warning("%s: --spec needs the ragged attention path; "
+                        "speculation disabled on this runtime", name)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_rollbacks = 0
+        # user -> [proposed, accepted]; users whose observed accept rate
+        # under-runs --spec-min-accept after a warmup sample stop
+        # speculating (the verify FLOPs stopped paying for themselves).
+        self._spec_user: Dict[str, list] = {}
+        self._spec_throttled: set = set()
+        self._tm_spec_prop = tm.SPEC_TOKENS_TOTAL.labels(
+            model=name, outcome="proposed")
+        self._tm_spec_acc = tm.SPEC_TOKENS_TOTAL.labels(
+            model=name, outcome="accepted")
+        self._tm_spec_rej = tm.SPEC_TOKENS_TOTAL.labels(
+            model=name, outcome="rejected")
+        self._tm_spec_rate = tm.SPEC_ACCEPT_RATE.labels(model=name)
+
         # Telemetry.
         self.step_latency_ms = 0.0
         self.prefill_latency_ms = 0.0
@@ -663,86 +689,156 @@ class ModelRuntime:
                   jnp.asarray(tp), jnp.asarray(pen), jnp.asarray(pres),
                   jnp.asarray(freq), jnp.asarray(seeds), key)
 
-    def _dispatch_ragged(self, T_pad, tokens, tok_seq, tok_pos, write_slots,
-                         q_start, q_len, kv_len, ring_len, is_first, append,
-                         seed_rows, slot_ids, pt, temp, tk, tp, pen, pres,
-                         freq, seeds, key):
-        self._fault("ragged")
+    def _dispatch_ragged(self, T_pad, k_cap, tokens, tok_seq, tok_pos,
+                         write_slots, q_start, q_len, kv_len, ring_len,
+                         is_first, append, is_spec, seed_rows, slot_ids, pt,
+                         temp, tk, tp, pen, pres, freq, seeds, key):
+        # Speculative dispatches get their own fault site: a chaos plan
+        # can target the verify span without perturbing plain mixed
+        # dispatches (and vice versa).
+        self._fault("spec_verify" if k_cap else "ragged")
         fn = self._get_ragged_jit(
-            T_pad, sampling_flags(temp, tk, tp, pen, pres, freq)
+            T_pad, k_cap, sampling_flags(temp, tk, tp, pen, pres, freq)
         )
-        return fn(self.params, jnp.asarray(tokens), jnp.asarray(tok_seq),
-                  jnp.asarray(tok_pos), jnp.asarray(write_slots),
-                  jnp.asarray(q_start), jnp.asarray(q_len),
-                  jnp.asarray(kv_len), jnp.asarray(ring_len),
-                  jnp.asarray(is_first), jnp.asarray(append),
-                  jnp.asarray(seed_rows), jnp.asarray(slot_ids),
-                  jnp.asarray(pt), self.kc, self.vc, self.recent,
-                  jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp),
-                  jnp.asarray(pen), jnp.asarray(pres), jnp.asarray(freq),
-                  jnp.asarray(seeds), key)
+        # Content-fingerprinted upload cache (_dev, the decode path's
+        # pattern): steady-state decode/spec ticks resend near-identical
+        # per-slot metadata — sampling params, page tables, seed rows,
+        # span flags — every dispatch; skipping unchanged uploads takes
+        # the host cost of a tick from ~20 device_puts to the handful
+        # that really changed. None of these are donated by the jit.
+        d = self._dev
+        return fn(self.params, d("rg_tok", tokens), d("rg_seq", tok_seq),
+                  d("rg_pos", tok_pos), d("rg_ws", write_slots),
+                  d("rg_qs", q_start), d("rg_ql", q_len),
+                  d("rg_kv", kv_len), d("rg_rl", ring_len),
+                  d("rg_first", is_first), d("rg_app", append),
+                  d("rg_spec", is_spec), d("rg_seed_rows", seed_rows),
+                  d("rg_slots", slot_ids), d("rg_pt", pt),
+                  self.kc, self.vc, self.recent,
+                  d("rg_temp", temp), d("rg_tk", tk), d("rg_tp", tp),
+                  d("rg_pen", pen), d("rg_pres", pres), d("rg_freq", freq),
+                  d("rg_seeds", seeds), key)
 
-    def _get_ragged_jit(self, T_pad: int, flags=(True, True, True)):
+    def _get_ragged_jit(self, T_pad: int, k_cap: int = 0,
+                        flags=(True, True, True)):
         """ONE mixed-batch step: forward the flattened [T_pad] token
-        stream (prefill spans + decode tokens) through forward_ragged,
-        then per-sequence penalty-ring maintenance and sampling — the
-        ragged-mode replacement for the prefill, chunk, AND single-step
-        decode jits. Compiles once per (padded token total, sampling
-        flags); the engine pads totals to the token granule to keep the
-        variant count small."""
-        key_ = ("ragged", T_pad, flags)
+        stream (prefill spans + decode tokens + speculative verify
+        spans) through forward_ragged, then per-sequence penalty-ring
+        maintenance and sampling — the ragged-mode replacement for the
+        prefill, chunk, AND single-step decode jits. Compiles once per
+        (padded token total, draft cap, sampling flags); the engine pads
+        totals to the token granule and uses only k_cap in {0, spec_k},
+        so the variant count stays small.
+
+        Speculative rows (is_spec=1) carry a (d+1)-token span
+        [last_token, draft_1..draft_d]: the forward reads a logit at
+        EVERY span position, greedy verification accepts the longest
+        prefix where draft == argmax (ops/sampling.accept_prefix), the
+        model's own next token caps the emission, and the penalty ring
+        advances by the ACCEPTED count — never by k — so ring state is
+        byte-identical to emitting the same tokens one step at a time.
+        Returns (toks [S, k_cap+1], n_emit [S], caches', recent'): row i
+        emits toks[i, :n_emit[i]]."""
+        key_ = ("ragged", T_pad, k_cap, flags)
         if key_ not in self._prefill_jits:
             cfg, ps = self.cfg, self.ecfg.page_size
             attn_impl = self.attn_impl
             need_pen, need_mask, need_sample = flags
+            O = k_cap + 1
 
             def fn(params, tokens, tok_seq, tok_pos, write_slots, q_start,
-                   q_len, kv_len, ring_len, is_first, append, seed_rows,
-                   slot_ids, pt, kc, vc, recent, temp, tk, tp, pen, pres,
-                   freq, seeds, key):
-                last_idx = jnp.clip(q_start + q_len - 1, 0, T_pad - 1)
+                   q_len, kv_len, ring_len, is_first, append, is_spec,
+                   seed_rows, slot_ids, pt, kc, vc, recent, temp, tk, tp,
+                   pen, pres, freq, seeds, key):
+                spec = is_spec > 0
+                # Logit read positions: non-spec rows read only their
+                # last valid token (every column aliases it — prefill
+                # spans can be longer than O); spec rows read every span
+                # position, so column j holds the argmax that verifies
+                # draft j+1 (and column `accepted` the bonus token).
+                j = jnp.arange(O)[None, :]
+                col = jnp.where(spec[:, None],
+                                jnp.minimum(j, q_len[:, None] - 1),
+                                q_len[:, None] - 1)
+                out_idx = jnp.clip(q_start[:, None] + col, 0, T_pad - 1)
                 logits, kc, vc = llama.forward_ragged(
                     params, cfg, tokens, tok_seq, tok_pos, write_slots,
-                    last_idx, kc, vc, pt, q_start, q_len, kv_len, ps,
+                    out_idx, kc, vc, pt, q_start, q_len, kv_len, ps,
                     attn_impl=attn_impl,
-                )
+                )  # [S, O, V]
+                greedy_all = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                last_logits = logits[:, -1, :]
+                if k_cap > 0:
+                    # Draft token j+1 sits in the stream right after the
+                    # span's input token; its verifier is greedy column j.
+                    jj = jnp.arange(k_cap)[None, :]
+                    draft_idx = jnp.clip(q_start[:, None] + 1 + jj, 0,
+                                         T_pad - 1)
+                    accepted = accept_prefix(tokens[draft_idx],
+                                             greedy_all[:, :k_cap],
+                                             q_len - 1)
+                    accepted = jnp.where(spec, accepted, 0)
+                else:
+                    accepted = jnp.zeros(q_start.shape[0], jnp.int32)
                 W = recent.shape[1]
                 rows = recent[slot_ids]  # [B, W]
                 # First span of a request: the ring opens from seed_rows
                 # (all -1 fresh, the cached prefix's last W tokens on a
                 # prefix-cache hit) — chunk-jit semantics, vectorized.
                 rows = jnp.where(is_first[:, None] > 0, seed_rows, rows)
-                # Slide each ring by ring_len tokens taken from the tail
-                # of the row's own stream span (ring_len = span length
-                # for prefill rows, 0 for decode rows whose input token
-                # already rolled in when it was sampled). new[j] is
-                # (rows ++ span)[ring_len + j] kept to the last W.
-                j = jnp.arange(W)[None, :]
-                cidx = ring_len[:, None] + j - W  # offset into the span
-                stream_idx = jnp.clip(q_start[:, None] + cidx, 0, T_pad - 1)
+                # Slide each ring by roll_n tokens taken from the row's
+                # own stream span: span length for prefill rows, 0 for
+                # plain decode rows (their input token already rolled in
+                # when it was sampled), and the ACCEPTED count for spec
+                # rows — whose rolled tokens start one past the span's
+                # input token (the accepted drafts). new[j] is
+                # (rows ++ rolled)[roll_n + j] kept to the last W.
+                roll_n = jnp.where(spec, accepted, ring_len)
+                base = q_start + spec.astype(jnp.int32)
+                j_w = jnp.arange(W)[None, :]
+                cidx = roll_n[:, None] + j_w - W  # offset into the span
+                stream_idx = jnp.clip(base[:, None] + cidx, 0, T_pad - 1)
                 from_stream = tokens[stream_idx]  # [B, W]
-                row_idx = jnp.clip(ring_len[:, None] + j, 0, W - 1)
+                row_idx = jnp.clip(roll_n[:, None] + j_w, 0, W - 1)
                 from_row = jnp.take_along_axis(rows, row_idx, axis=1)
                 new_rows = jnp.where(cidx >= 0, from_stream, from_row)
-                pen_logits = maybe_apply_penalties(logits, new_rows, pen,
-                                                   pres, freq, need_pen)
+                pen_logits = maybe_apply_penalties(last_logits, new_rows,
+                                                   pen, pres, freq,
+                                                   need_pen)
                 # kv_len IS the position being sampled in both shapes:
                 # n for a span ending a prompt of n tokens (prefill
                 # folded seq_lens) and positions+1 for a decode row.
                 row_keys = per_row_keys(key, seeds, kv_len)
                 tok = sample_tokens_rowwise(pen_logits, row_keys, temp, tk,
                                             tp, need_mask, need_sample)
-                # Rows that EMIT (decode rows, final prefill spans) roll
-                # the sampled token in; mid-prefill spans do not.
+                if k_cap > 0:
+                    # Spec rows take the model's own token at the first
+                    # rejected position (or past the last accepted draft)
+                    # — exactly the token non-speculative greedy would
+                    # sample next. Speculation is host-gated to greedy
+                    # no-penalty rows, so raw argmax IS that token.
+                    spec_next = jnp.take_along_axis(
+                        greedy_all, accepted[:, None], axis=1)[:, 0]
+                    tok = jnp.where(spec, spec_next, tok)
+                # Rows that EMIT (decode/spec rows, final prefill spans)
+                # roll the final token in; mid-prefill spans do not.
                 appended = jnp.concatenate([new_rows[:, 1:], tok[:, None]],
                                            axis=1)
                 final_rows = jnp.where(append[:, None] > 0, appended,
                                        new_rows)
                 recent = recent.at[slot_ids].set(final_rows)
-                return tok, kc, vc, recent
+                # Emitted tokens, row-major: spec rows emit the accepted
+                # drafts (greedy columns 0..accepted-1 — accepted drafts
+                # ARE their verifying argmaxes) plus the bonus token at
+                # column `accepted`; every other row emits column 0.
+                n_emit = jnp.where(spec, accepted + 1, 1)
+                col0 = jnp.where(spec, greedy_all[:, 0], tok)
+                toks = jnp.concatenate([col0[:, None], greedy_all[:, 1:]],
+                                       axis=1)
+                return toks, n_emit, kc, vc, recent
 
             self._prefill_jits[key_] = jax.jit(
-                fn, donate_argnums=(14, 15, 16)
+                fn, donate_argnums=(15, 16, 17)
             )
         return self._prefill_jits[key_]
 
@@ -1074,7 +1170,8 @@ class ModelRuntime:
         req.stats.completion_tokens = len(req.generated_ids)
         if reason == FinishReason.CANCELLED:
             core.mark_dropped(req.user)
-        elif reason in (FinishReason.KV_EXHAUSTED, FinishReason.ERROR):
+        elif reason in (FinishReason.KV_EXHAUSTED, FinishReason.ERROR,
+                        FinishReason.DEADLINE):
             # Honest failure: the client keeps the text generated so far
             # (flushed) but the request counts dropped, not processed.
             if flush:
@@ -1505,6 +1602,120 @@ class ModelRuntime:
             self.last_tokens[slot] = tok
             self.seq_lens[slot] = n
 
+    # -- speculative decoding (n-gram draft + ragged verify) ---------------
+    # Accept-rate warmup sample per user before the auto-throttle may
+    # fire, and how far back the n-gram proposer searches (longer
+    # contexts still match — recency wins — but the scan stays O(window)
+    # per tick, never O(context)).
+    SPEC_THROTTLE_SAMPLE = 64
+    SPEC_LOOKUP_WINDOW = 1024
+    SPEC_NGRAMS = (3, 2)
+
+    def _spec_eligible(self, req: Request) -> bool:
+        """Speculation is host-gated to rows whose sampling the greedy
+        verifier reproduces exactly: temperature 0 (argmax) with neutral
+        penalties — a penalized row's argmax depends on the ring state
+        at EACH draft position, which the single-dispatch verify does
+        not replay. Sampled/penalized requests stay 1-token decode rows
+        (byte-identical either way); throttled users sit out."""
+        s = req.sampling
+        return (s.temperature == 0.0 and s.repeat_penalty == 1.0
+                and s.presence_penalty == 0.0 and s.frequency_penalty == 0.0
+                and req.user not in self._spec_throttled)
+
+    def _propose_drafts(self, req: Request, slot: int) -> List[int]:
+        """Prompt-lookup draft proposal: match the context's trailing
+        n-gram (n in SPEC_NGRAMS, longest first) against its most recent
+        earlier occurrence and propose the tokens that followed — free
+        (no second model, no device work) and strong exactly when the
+        model is reproducing earlier text (repetitive generation, quote-
+        the-prompt workloads). Returns [] when nothing matches or no
+        budget remains; caps at spec_k, the request's remaining token
+        budget, and the context ceiling."""
+        k = self.ecfg.spec_k
+        remaining = req.sampling.max_tokens - len(req.generated_ids) - 1
+        max_ctx = min(self.ecfg.max_context, self.cfg.max_seq_len)
+        pos = int(self.seq_lens[slot])
+        k = min(k, remaining, max_ctx - pos - 2)
+        if k <= 0:
+            return []
+        # Full token history as the decoder saw it: a preempted request
+        # folded already-streamed ids into prompt_tokens, so only the
+        # post-replay generated tail appends.
+        ctx = req.prompt_tokens + req.generated_ids[req._replay_gen:]
+        lo = max(0, len(ctx) - self.SPEC_LOOKUP_WINDOW)
+        for n in self.SPEC_NGRAMS:
+            if len(ctx) - lo < n + 1:
+                continue
+            key = ctx[-n:]
+            for s in range(len(ctx) - n - 1, lo - 1, -1):
+                if ctx[s:s + n] == key:
+                    drafts = ctx[s + n:s + n + k]
+                    if drafts:
+                        return list(drafts)
+                    break
+        return []
+
+    def _note_spec_outcome(self, req: Request, proposed: int,
+                           accepted: int) -> None:
+        """Per-dispatch speculative accounting: totals, the accept-rate
+        gauge, and the per-user auto-throttle — a user whose drafts keep
+        getting rejected stops paying the (proposed - accepted) wasted
+        verify tokens on every dispatch."""
+        self.spec_proposed += proposed
+        self.spec_accepted += accepted
+        self._tm_spec_prop.inc(proposed)
+        self._tm_spec_acc.inc(accepted)
+        self._tm_spec_rej.inc(proposed - accepted)
+        if self.spec_proposed:
+            self._tm_spec_rate.set(
+                round(self.spec_accepted / self.spec_proposed, 4))
+        row = self._spec_user.setdefault(req.user, [0, 0])
+        row[0] += proposed
+        row[1] += accepted
+        min_rate = self.ecfg.spec_min_accept
+        if (min_rate > 0 and row[0] >= self.SPEC_THROTTLE_SAMPLE
+                and row[1] / row[0] < min_rate
+                and req.user not in self._spec_throttled):
+            self._spec_throttled.add(req.user)
+            log.info("%s: speculation throttled for user %s (accept rate "
+                     "%.2f < %.2f over %d proposed)", self.name, req.user,
+                     row[1] / row[0], min_rate, row[0])
+
+    def _rollback_spec(self, slot: int, req: Request, kv_before: int,
+                       kv_after: int) -> None:
+        """Release the page claim of rejected draft tokens: the slot
+        keeps exactly the pages its ACCEPTED context needs. Shared
+        prefix-tree pages lead slot_pages and are floored out of the
+        truncation — speculation must never free a page the radix tree
+        owns. Rejected positions on device need no un-write: they sit
+        past the rolled-back kv_len, masked by attention and overwritten
+        by the next real decode step."""
+        self.spec_rollbacks += 1
+        keep = len(self.slot_pins[slot])
+        freed = self.alloc.rollback_to(self.slot_pages[slot], kv_after,
+                                       keep=keep)
+        if freed:
+            self.page_table[slot, :] = kvc.make_page_table_row(
+                self.slot_pages[slot], self.ecfg.max_pages_per_seq)
+        self._jrec("spec_rollback", req, slot=slot, kv_before=kv_before,
+                   kv_after=kv_after, freed=freed, **self._page_state())
+
+    def _drop_expired_slot(self, slot: int, core: MQCore) -> None:
+        """Deadline enforcement at the speculative composer: an expired
+        request must not burn a k-token verify span (the same
+        before-the-dispatch check prefill and chunking already make).
+        The slot finishes with the explicit deadline reason — text
+        streamed so far flushes, the drop counts as dropped work."""
+        req = self.slot_req[slot]
+        tm.DEADLINE_DROPS_TOTAL.labels(model=self.name).inc()
+        tm.SHED_TOTAL.labels(reason="deadline").inc()
+        slack = ((time.monotonic() - req.deadline) * 1e3
+                 if req.deadline is not None else 0.0)
+        self._jrec("deadline_drop", req, slack_ms=round(slack, 3))
+        self._finish_slot(slot, FinishReason.DEADLINE, core,
+                          error="deadline expired before completion")
+
     # -- preemption with recompute -----------------------------------------
     KV_EXHAUSTED_MSG = ("KV page pool exhausted mid-decode and preemption "
                        "is disabled; retry, shorten the prompt, or raise "
@@ -1890,45 +2101,86 @@ class ModelRuntime:
 
     def step_ragged(self, core: MQCore) -> bool:
         """ONE ragged mixed-batch tick: admit pending prompts, then pack
-        every live decode slot (one token each) plus as many prefill-
-        span tokens as the --max-batch-tokens budget allows into a
-        single dispatch — prompts of any length mix freely, and the only
+        every live decode slot (one token each — or, with --spec, a
+        (1+k)-token speculative verify span) plus as many prefill-span
+        tokens as the --max-batch-tokens budget allows into a single
+        dispatch — prompts of any length mix freely, and the only
         padding is the stream total rounding up to the token granule.
         Returns True when a mixed dispatch ran (decode slots advanced
-        one step inside it); False leaves decode to the fused-scan path.
+        inside it); False leaves decode to the fused-scan path.
         """
         self._admit_ragged(core)
-        if not self.chunking:
+        if not self.chunking and not self.spec:
+            return False
+        if not self.chunking and not any(r is not None
+                                         for r in self.slot_req):
             return False
 
-        # Decode-row page headroom for one token, as step_decode_dispatch
-        # does per chunk (reservation-holders get their retry first).
+        # Decode-row page headroom, as step_decode_dispatch does per
+        # chunk (reservation-holders get their retry first). Speculating
+        # slots claim headroom for their whole draft span OPTIMISTICALLY
+        # — rejected drafts' pages roll back after the verify — but a
+        # draft is dropped, never stalled on, when the pool can't cover
+        # it: speculation is an optimization, not a page priority.
         for i in sorted(self._stalled_slots):
             if self.slot_req[i] is None:
                 self._stalled_slots.discard(i)
             elif self._extend_pages(self.slot_pages[i],
                                     int(self.seq_lens[i]) + 1):
                 self._stalled_slots.discard(i)
+        spec_plan: Dict[int, List[int]] = {}  # slot -> draft tokens
+        n_active = sum(1 for i, r in enumerate(self.slot_req)
+                       if r is not None and i not in self._stalled_slots)
+        # Draft budget: the stream must always fit every decode row at
+        # one token plus whatever drafts we compose.
+        spec_budget = self._ragged_budget - n_active
         for i, r in enumerate(self.slot_req):
             if r is None or i in self._stalled_slots:
                 continue
-            need = int(self.seq_lens[i]) + 1
-            if not self._extend_pages(self.slot_pages[i], need):
+            drafts: List[int] = []
+            if self.spec and self._spec_eligible(r):
+                if r.expired():
+                    # Deadline check BEFORE composing the verify span —
+                    # an expired request must not burn a k-token
+                    # verification (satellite bugfix; prefill and chunk
+                    # already check at their dispatch sites).
+                    self._drop_expired_slot(i, core)
+                    continue
+                drafts = self._propose_drafts(r, i)[:max(0, spec_budget)]
+            need = int(self.seq_lens[i]) + 1 + len(drafts)
+            if drafts and not self._extend_pages(self.slot_pages[i], need):
+                drafts = []  # no headroom to speculate: plain decode row
+                need = int(self.seq_lens[i]) + 1
+            if not drafts and not self._extend_pages(self.slot_pages[i],
+                                                     need):
                 self._page_exhausted(i, need, core)
             if self.slot_req[i] is not None and i not in self._stalled_slots:
                 self.page_table[i, :] = kvc.make_page_table_row(
                     self.slot_pages[i], self.ecfg.max_pages_per_seq
                 )
+                if drafts:
+                    spec_plan[i] = drafts
+                    spec_budget -= len(drafts)
+                    self._jrec("speculate", r, slot=i, k=len(drafts),
+                               source="ngram")
+        if not self.chunking and not spec_plan:
+            return False  # nothing multi-token this tick: decode fused
 
-        # Compose: decode rows first (every live stream advances), then
-        # prefill spans in FIFO order until the budget runs out.
-        budget = self._ragged_budget
-        rows: List[tuple] = []  # (kind, slot, req, chunk_pos, span)
+        # Compose: decode/spec rows first (every live stream advances,
+        # and the ladder trim below must only ever shorten prefill
+        # tails), then prefill spans in FIFO order until the budget runs
+        # out. Spec rows ride as (kind="spec", slot, req, drafts, 1+d).
+        rows: List[tuple] = []  # (kind, slot, req, chunk_pos|drafts, span)
         for i, r in enumerate(self.slot_req):
             if r is not None and i not in self._stalled_slots:
-                rows.append(("decode", i, r, 0, 1))
+                d = spec_plan.get(i)
+                if d:
+                    rows.append(("spec", i, r, d, 1 + len(d)))
+                else:
+                    rows.append(("decode", i, r, 0, 1))
         n_decode = len(rows)
-        budget -= n_decode
+        fixed_tokens = sum(span for *_, span in rows)
+        budget = self._ragged_budget - fixed_tokens
         now = time.monotonic()
         for req in list(self.chunking):
             if budget <= 0:
@@ -1951,18 +2203,21 @@ class ModelRuntime:
                 continue
             rows.append(("prefill", slot, req, req._chunk_pos, span))
             budget -= span
-        if len(rows) == n_decode:
+        if len(rows) == n_decode and not spec_plan:
             return False  # no span ready this tick: decode runs fused
 
         # Pick the dispatch total from the compile ladder. Prefer the
         # largest rung we can TRIM down to (tail prefill tokens just go
         # next tick — no compute wasted); pad up to the next rung only
-        # when the decode rows alone nearly fill the stream and leave no
-        # prefill slack to trim.
+        # when the decode/spec rows alone nearly fill the stream and
+        # leave no prefill slack to trim. Spec spans are never trimmed:
+        # the lower bound covers every fixed token (decode rows + draft
+        # spans), so the cut below only ever shortens prefill tails.
         T_raw = sum(span for *_, span in rows)
+        lower = fixed_tokens + (1 if len(rows) > n_decode else 0)
         L = None
         for v in reversed(self._ragged_ladder):
-            if v <= T_raw and v >= n_decode + 1:
+            if v <= T_raw and v >= lower:
                 L = v
                 break
         if L is None:
@@ -1996,6 +2251,7 @@ class ModelRuntime:
         ring_len = np.zeros(S, np.int32)
         is_first = np.zeros(S, np.int32)
         append = np.zeros(S, np.int32)
+        is_spec = np.zeros(S, np.int32)
         seed_rows = np.full((S, W), -1, np.int32)
         slot_ids = np.full(S, S, np.int32)  # padding -> trash ring row
         pt_rows = np.full((S, MP), kvc.TRASH_PAGE, np.int32)
@@ -2030,6 +2286,27 @@ class ModelRuntime:
                 kv_len[idx] = pos + 1
                 append[idx] = 1  # ring_len 0: input token already rolled
                 pt_rows[idx] = row
+            elif kind == "spec":
+                # Speculative verify span: the slot's input token plus
+                # its drafts, written optimistically at positions
+                # pos..pos+d (rejected positions are masked by the
+                # rolled-back kv_len and overwritten later). The jit
+                # computes the accepted count and advances the ring by
+                # it; append always rolls in the bonus token.
+                drafts = cpos  # rows tuple carries the draft list here
+                pos = int(self.seq_lens[slot])
+                d = len(drafts)
+                tokens[off:off + d + 1] = [self.last_tokens[slot]] + drafts
+                tok_seq[off:off + d + 1] = idx
+                positions = np.arange(pos, pos + d + 1, dtype=np.int32)
+                tok_pos[off:off + d + 1] = positions
+                row = self.page_table[slot]
+                write_slots[off:off + d + 1] = (
+                    row[positions // ps] * ps + positions % ps)
+                kv_len[idx] = pos + 1 + d
+                is_spec[idx] = 1
+                append[idx] = 1
+                pt_rows[idx] = row
             else:
                 piece = req.prompt_tokens[cpos:cpos + span]
                 tokens[off:off + span] = piece
@@ -2057,17 +2334,29 @@ class ModelRuntime:
             off += span
 
         prefill_rows = [r for r in rows if r[0] == "prefill"]
+        spec_rows = [r for r in rows if r[0] == "spec"]
+        spec_tokens = sum(len(r[3]) for r in spec_rows)
+        # k_cap in {0, spec_k}: one extra compile variant total when
+        # speculation is live, not one per observed draft length.
+        k_cap = self.ecfg.spec_k if spec_rows else 0
         self.inflight_prefill = [req for _, _, req, _, _ in prefill_rows]
-        self._jrec("batch",
-                   slots=[slot for _, slot, *_ in rows],
-                   reqs=[req.req_id for _, _, req, _, _ in rows],
-                   batch_size=len(rows), tokens=int(T_real),
-                   occupancy=round(len(rows) / max(1, S), 4),
-                   pending=(len(self.pending_prefill)
-                            + len(self.chunking)),
-                   free_pages=self.alloc.free_pages,
-                   mode="ragged", padded_tokens=int(T_pad),
-                   n_decode=n_decode, n_prefill=len(prefill_rows))
+        # Batch-compose decision inputs, recorded AFTER the dispatch so
+        # the record can also carry the per-dispatch accepted-token
+        # count (the speculative scoreboard reads straight off batch
+        # records); a failed dispatch records them without it.
+        batch_fields = dict(
+            slots=[slot for _, slot, *_ in rows],
+            reqs=[req.req_id for _, _, req, _, _ in rows],
+            batch_size=len(rows), tokens=int(T_real),
+            occupancy=round(len(rows) / max(1, S), 4),
+            pending=(len(self.pending_prefill) + len(self.chunking)),
+            free_pages=self.alloc.free_pages,
+            mode="ragged", padded_tokens=int(T_pad),
+            n_decode=n_decode - len(spec_rows),
+            n_prefill=len(prefill_rows))
+        if spec_rows:
+            batch_fields["n_spec"] = len(spec_rows)
+            batch_fields["spec_tokens"] = int(spec_tokens)
         if (self.attn_impl == "pallas" and not self._pallas_proven
                 and jax.process_count() == 1):
             # Probe the unproven Pallas ragged kernel with an AOT compile
@@ -2078,14 +2367,15 @@ class ModelRuntime:
             try:
                 probe_flags = sampling_flags(temp, top_k, top_p, pen,
                                              pres, freq)
-                self._get_ragged_jit(T_pad, probe_flags).lower(
+                self._get_ragged_jit(T_pad, k_cap, probe_flags).lower(
                     self.params, jnp.asarray(tokens), jnp.asarray(tok_seq),
                     jnp.asarray(tok_pos), jnp.asarray(write_slots),
                     jnp.asarray(q_start), jnp.asarray(q_len),
                     jnp.asarray(kv_len), jnp.asarray(ring_len),
                     jnp.asarray(is_first), jnp.asarray(append),
-                    jnp.asarray(seed_rows), jnp.asarray(slot_ids),
-                    jnp.asarray(pt_rows), self.kc, self.vc, self.recent,
+                    jnp.asarray(is_spec), jnp.asarray(seed_rows),
+                    jnp.asarray(slot_ids), jnp.asarray(pt_rows),
+                    self.kc, self.vc, self.recent,
                     jnp.asarray(temp), jnp.asarray(top_k),
                     jnp.asarray(top_p), jnp.asarray(pen),
                     jnp.asarray(pres), jnp.asarray(freq),
@@ -2106,19 +2396,27 @@ class ModelRuntime:
                 }
         t0 = time.monotonic()
         try:
-            toks, self.kc, self.vc, self.recent = self._dispatch_ragged(
-                T_pad, tokens, tok_seq, tok_pos, write_slots, q_start,
-                q_len, kv_len, ring_len, is_first, append, seed_rows,
-                slot_ids, pt_rows, temp, top_k, top_p, pen, pres, freq,
-                seeds, self._next_key(),
-            )
-            toks = np.asarray(toks)
+            toks, n_emit, self.kc, self.vc, self.recent = \
+                self._dispatch_ragged(
+                    T_pad, k_cap, tokens, tok_seq, tok_pos, write_slots,
+                    q_start, q_len, kv_len, ring_len, is_first, append,
+                    is_spec, seed_rows, slot_ids, pt_rows, temp, top_k,
+                    top_p, pen, pres, freq, seeds, self._next_key(),
+                )
+            toks = np.asarray(toks)  # [S, k_cap+1]
+            n_emit = np.asarray(n_emit)  # [S]
         except Exception as e:
+            self._jrec("batch", **batch_fields)
             self._ragged_failed(rows, e, core)
             return True
         finally:
             self.inflight_prefill = []
         dt = time.monotonic() - t0
+        if spec_rows:
+            batch_fields["spec_accepted"] = int(sum(
+                int(n_emit[idx]) - 1
+                for idx, r in enumerate(rows) if r[0] == "spec"))
+        self._jrec("batch", **batch_fields)
 
         waste = (T_pad - T_real) / max(1, T_pad)
         self._tm_padding.set(round(waste, 4))
@@ -2135,15 +2433,36 @@ class ModelRuntime:
 
         emitted = 0
         for idx, (kind, slot, req, cpos, span) in enumerate(rows):
-            if kind == "decode":
+            if kind in ("decode", "spec"):
                 if self.slot_req[slot] is not req:
                     continue  # finished/cancelled between compose & emit
-                tok = int(toks[idx])
-                self.seq_lens[slot] += 1
-                self.tokens_generated += 1
-                emitted += 1
-                if self._emit_token(slot, tok, core):
-                    self.last_tokens[slot] = tok
+                n = int(n_emit[idx])  # 1 for decode; accepted+1 for spec
+                kv_before = int(self.seq_lens[slot]) + span
+                for jtok in range(n):
+                    if self.slot_req[slot] is not req:
+                        break  # EOS / stop string / cap hit mid-emission
+                    tok = int(toks[idx, jtok])
+                    self.seq_lens[slot] += 1
+                    self.tokens_generated += 1
+                    emitted += 1
+                    if self._emit_token(slot, tok, core):
+                        self.last_tokens[slot] = tok
+                if kind == "spec":
+                    proposed = span - 1
+                    accepted = n - 1
+                    self._note_spec_outcome(req, proposed, accepted)
+                    self._jrec("spec_verify", req, slot=slot,
+                               proposed=proposed, accepted=accepted,
+                               rolled_back=proposed - accepted)
+                    if (proposed > accepted
+                            and self.slot_req[slot] is req):
+                        # Rejected drafts wrote KV past the accepted
+                        # context: release their page claim (the finish
+                        # paths above already freed everything when the
+                        # stream ended mid-emission).
+                        self._rollback_spec(
+                            slot, req, kv_before,
+                            int(self.seq_lens[slot]) + 1)
             else:
                 req._chunk_pos = cpos + span
                 if req._chunk_pos >= len(req.prompt_tokens):
@@ -2157,7 +2476,7 @@ class ModelRuntime:
                     self.page_table[slot, :] = req._pt_row[0]
                     self._install_slot(slot, req,
                                        len(req.prompt_tokens),
-                                       int(toks[idx]), core)
+                                       int(toks[idx, 0]), core)
 
         self._tm_tokens.inc(emitted)
         active = [i for i, r in enumerate(self.slot_req) if r is not None]
@@ -2473,6 +2792,16 @@ class ModelRuntime:
             # None = caching disabled (the TUI renders "cache n/a").
             "prefix_cache": (self.prefix_cache.stats()
                              if self.prefix_cache is not None else None),
+            # None = speculation disabled on this runtime.
+            "spec": ({
+                "proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+                "accept_rate": round(
+                    self.spec_accepted / self.spec_proposed, 4)
+                if self.spec_proposed else 0.0,
+                "rollbacks": self.spec_rollbacks,
+                "throttled_users": len(self._spec_throttled),
+            } if self.spec else None),
         }
 
 
@@ -2574,6 +2903,7 @@ class EncoderRuntime:
             "param_bytes": self.param_bytes,
             "kv_bytes": self.kv_bytes,
             "prefix_cache": None,  # encoders hold no KV to share
+            "spec": None,  # encoders decode nothing to speculate on
         }
 
 

@@ -156,43 +156,65 @@ class FakeRuntime:
                            tokens=len(req.generated_ids))
                 req.finish(FinishReason.CANCELLED)
                 continue
-            word = f"word{req._fake_idx} "
-            req._fake_idx += 1
-            req._fake_remaining -= 1
-            req.generated_ids.append(req._fake_idx)
-            self.tokens_generated += 1
-            self._tm_tokens.inc()
-            if not req.stats.first_token_at:
-                req.stats.first_token_at = time.monotonic()
-                self._tm_ttft.observe(req.stats.ttft_ms)
-                self._tm_tpot.observe(self.token_latency_s * 1e3)
-                if self.slo is not None:
-                    self.slo.record("ttft", req.stats.ttft_ms)
-                req.trace_event("first_token",
-                                ttft_ms=round(req.stats.ttft_ms, 3))
-            elif self.slo is not None:
-                self.slo.record("tpot", self.token_latency_s * 1e3)
-            chunk = req.emit_text(word)
-            if chunk is None:
-                self.active.remove(req)
-                core.mark_done(req.user, tokens=len(req.generated_ids))
-                req.stats.completion_tokens = len(req.generated_ids)
-                self._jrec("finish", req, reason="stop",
-                           tokens=len(req.generated_ids))
-                req.finish(FinishReason.STOP)
-                continue
-            if chunk:
-                req.stream.push(StreamItem("token", text=chunk))
-            if req._fake_remaining <= 0:
-                self.active.remove(req)
-                tail = req.flush_text()
-                if tail:
-                    req.stream.push(StreamItem("token", text=tail))
-                core.mark_done(req.user, tokens=len(req.generated_ids))
-                req.stats.completion_tokens = len(req.generated_ids)
-                self._jrec("finish", req, reason="length",
-                           tokens=len(req.generated_ids))
-                req.finish(FinishReason.LENGTH)
+            # Speculative fake: with --spec the step emits 1 + k words at
+            # once and journals the speculate/spec_verify decision pair —
+            # the fake word stream is deterministic regardless of
+            # stepping, so spec-on/off streams stay identical while the
+            # journal vocabulary (and its invariants, /debug surfaces,
+            # replay harness) exercise without jax. Fake drafts always
+            # verify: the "model" IS the proposer here.
+            emit_n = 1
+            if (self.ecfg.spec and self.ecfg.spec_k > 0
+                    and req._fake_remaining > 1):
+                k = min(self.ecfg.spec_k, req._fake_remaining - 1)
+                self._jrec("speculate", req, slot=-1, k=k, source="fake")
+                self._jrec("spec_verify", req, slot=-1, proposed=k,
+                           accepted=k, rolled_back=0)
+                tm.SPEC_TOKENS_TOTAL.labels(
+                    model=self.name, outcome="proposed").inc(k)
+                tm.SPEC_TOKENS_TOTAL.labels(
+                    model=self.name, outcome="accepted").inc(k)
+                tm.SPEC_ACCEPT_RATE.labels(model=self.name).set(1.0)
+                emit_n = 1 + k
+            for _ in range(emit_n):
+                word = f"word{req._fake_idx} "
+                req._fake_idx += 1
+                req._fake_remaining -= 1
+                req.generated_ids.append(req._fake_idx)
+                self.tokens_generated += 1
+                self._tm_tokens.inc()
+                if not req.stats.first_token_at:
+                    req.stats.first_token_at = time.monotonic()
+                    self._tm_ttft.observe(req.stats.ttft_ms)
+                    self._tm_tpot.observe(self.token_latency_s * 1e3)
+                    if self.slo is not None:
+                        self.slo.record("ttft", req.stats.ttft_ms)
+                    req.trace_event("first_token",
+                                    ttft_ms=round(req.stats.ttft_ms, 3))
+                elif self.slo is not None:
+                    self.slo.record("tpot", self.token_latency_s * 1e3)
+                chunk = req.emit_text(word)
+                if chunk is None:
+                    self.active.remove(req)
+                    core.mark_done(req.user, tokens=len(req.generated_ids))
+                    req.stats.completion_tokens = len(req.generated_ids)
+                    self._jrec("finish", req, reason="stop",
+                               tokens=len(req.generated_ids))
+                    req.finish(FinishReason.STOP)
+                    break
+                if chunk:
+                    req.stream.push(StreamItem("token", text=chunk))
+                if req._fake_remaining <= 0:
+                    self.active.remove(req)
+                    tail = req.flush_text()
+                    if tail:
+                        req.stream.push(StreamItem("token", text=tail))
+                    core.mark_done(req.user, tokens=len(req.generated_ids))
+                    req.stats.completion_tokens = len(req.generated_ids)
+                    self._jrec("finish", req, reason="length",
+                               tokens=len(req.generated_ids))
+                    req.finish(FinishReason.LENGTH)
+                    break
 
     def _fake_embedding(self, req: Request) -> list:
         # Deterministic unit vector derived from the prompt bytes.
@@ -221,6 +243,7 @@ class FakeRuntime:
             "param_bytes": self.param_bytes,
             "kv_bytes": self.kv_bytes,
             "prefix_cache": None,  # fake tokens carry no KV to share
+            "spec": None,  # fake drafts never roll back
         }
 
 

@@ -94,6 +94,28 @@ class PageAllocator:
             pages.append(self._free.pop())
         return True
 
+    def rollback_to(self, pages: List[int], kv_len: int,
+                    keep: int = 0) -> int:
+        """Speculative rollback: shrink an allocation (in place) to the
+        pages a sequence of `kv_len` WRITTEN tokens actually needs,
+        returning the rejected tail pages to the free list. `keep` floors
+        the truncation at the sequence's shared prefix-tree pages (they
+        lead the list and are owned by the tree, never this allocator's
+        free list). Returns the number of pages freed.
+
+        The device-side "un-write" is free: rejected draft positions sit
+        past the rolled-back kv_len, so attention masks them out and the
+        next real decode step overwrites them — only the host-side page
+        claim needs releasing."""
+        target = max(self.pages_needed(max(1, kv_len)), keep)
+        freed = 0
+        while len(pages) > target:
+            p = pages.pop()
+            if p != TRASH_PAGE:
+                self._free.append(p)
+                freed += 1
+        return freed
+
     def free(self, pages: List[int]) -> None:
         for p in pages:
             if p != TRASH_PAGE:

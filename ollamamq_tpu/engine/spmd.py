@@ -35,6 +35,11 @@ Opcode header (int32[5]: [op, a, b, model_ordinal, replica_ordinal]):
                                     causal forward + mean pool, stateless)
     OP_RAGGED   = 10, a=T_pad      (ragged mixed batch: prefill spans +
                                     decode rows in one flattened stream)
+    OP_SPEC     = 11, a=T_pad, b=k_cap (ragged mixed batch carrying
+                                    speculative verify spans: the RAGGED
+                                    payload plus the per-row is_spec
+                                    flag; k_cap sizes the multi-token
+                                    output shape on every host)
 
 Data parallelism under SPMD: dp replicas each live on a slice of the
 mesh's data axis. make_mesh arranges the dp axis intra-host when
@@ -93,6 +98,7 @@ OP_LOAD = 7
 OP_EVICT = 8
 OP_EMBED = 9  # a=B, b=bucket: embed batch on a GENERATIVE runtime
 OP_RAGGED = 10  # a=T_pad: ragged mixed batch (prefill spans + decode rows)
+OP_SPEC = 11  # a=T_pad, b=k_cap: ragged mixed batch + speculative spans
 
 KEY_SHAPE = (2,)  # raw uint32 threefry key data
 NAME_LEN = 128  # utf-8 bytes, zero-padded, for OP_LOAD/OP_EVICT names
@@ -331,6 +337,16 @@ def payload_spec(op, a, b, S, MP, W):
                 + [((S,), np.int32)] * 7
                 + [((S, W), np.int32), ((S, MP), np.int32)]
                 + samp(S) + key)
+    if op == OP_SPEC:
+        # The RAGGED payload plus the per-row is_spec flag (the eighth
+        # [S] vector, after append / before slot_ids); k_cap rides the
+        # header's b so every host compiles the same multi-token output
+        # shape.
+        T = a
+        return ([((T,), np.int32)] * 4
+                + [((S,), np.int32)] * 8
+                + [((S, W), np.int32), ((S, MP), np.int32)]
+                + samp(S) + key)
     if op in (OP_ENCODE, OP_EMBED):
         B, bucket = a, b
         return [((B, bucket), np.int32), ((B,), np.int32)]
@@ -514,7 +530,7 @@ def _raise_on_worker_failure(flags: Optional[np.ndarray], name: str) -> None:
 
 _OP_SITE = {OP_PREFILL: "prefill", OP_CHUNK: "chunk", OP_DECODE: "decode",
             OP_PREFILL_SP: "sp_prefill", OP_RAGGED: "ragged",
-            OP_EMBED: "embed", OP_ENCODE: "encode"}
+            OP_SPEC: "spec_verify", OP_EMBED: "embed", OP_ENCODE: "encode"}
 
 
 def _mirrored_dispatch(rt, op, a, b, values, dispatch):
@@ -641,24 +657,36 @@ class SPMDModelRuntime(ModelRuntime):
                 T, tokens, lens, slot_ids, pt_rows, temp, tk, tp, pen,
                 pres, freq, seeds, key))
 
-    def _dispatch_ragged(self, T_pad, tokens, tok_seq, tok_pos, write_slots,
-                         q_start, q_len, kv_len, ring_len, is_first, append,
-                         seed_rows, slot_ids, pt, temp, tk, tp, pen, pres,
-                         freq, seeds, key):
+    def _dispatch_ragged(self, T_pad, k_cap, tokens, tok_seq, tok_pos,
+                         write_slots, q_start, q_len, kv_len, ring_len,
+                         is_first, append, is_spec, seed_rows, slot_ids, pt,
+                         temp, tk, tp, pen, pres, freq, seeds, key):
         if not self._spmd:
             return super()._dispatch_ragged(
-                T_pad, tokens, tok_seq, tok_pos, write_slots, q_start,
-                q_len, kv_len, ring_len, is_first, append, seed_rows,
-                slot_ids, pt, temp, tk, tp, pen, pres, freq, seeds, key)
+                T_pad, k_cap, tokens, tok_seq, tok_pos, write_slots,
+                q_start, q_len, kv_len, ring_len, is_first, append, is_spec,
+                seed_rows, slot_ids, pt, temp, tk, tp, pen, pres, freq,
+                seeds, key)
+        # Plain mixed batches keep the OP_RAGGED wire shape; only
+        # dispatches actually carrying verify spans pay for (and ship)
+        # the is_spec vector + multi-token output (OP_SPEC, b=k_cap).
+        if k_cap:
+            op, payload = OP_SPEC, (
+                tokens, tok_seq, tok_pos, write_slots, q_start, q_len,
+                kv_len, ring_len, is_first, append, is_spec, slot_ids,
+                seed_rows, pt, temp, tk, tp, pen, pres, freq, seeds, key)
+        else:
+            op, payload = OP_RAGGED, (
+                tokens, tok_seq, tok_pos, write_slots, q_start, q_len,
+                kv_len, ring_len, is_first, append, slot_ids, seed_rows,
+                pt, temp, tk, tp, pen, pres, freq, seeds, key)
         return self._mirrored(
-            OP_RAGGED, T_pad, 0,
-            (tokens, tok_seq, tok_pos, write_slots, q_start, q_len, kv_len,
-             ring_len, is_first, append, slot_ids, seed_rows, pt, temp, tk,
-             tp, pen, pres, freq, seeds, key),
+            op, T_pad, k_cap, payload,
             lambda: super(SPMDModelRuntime, self)._dispatch_ragged(
-                T_pad, tokens, tok_seq, tok_pos, write_slots, q_start,
-                q_len, kv_len, ring_len, is_first, append, seed_rows,
-                slot_ids, pt, temp, tk, tp, pen, pres, freq, seeds, key))
+                T_pad, k_cap, tokens, tok_seq, tok_pos, write_slots,
+                q_start, q_len, kv_len, ring_len, is_first, append, is_spec,
+                seed_rows, slot_ids, pt, temp, tk, tp, pen, pres, freq,
+                seeds, key))
 
     def _dispatch_embed(self, B, bucket, tokens, lens):
         if not self._spmd:
@@ -978,7 +1006,7 @@ def run_worker(
     MP = engine_cfg.max_pages_per_seq
     W = engine_cfg.repeat_last_n
     DATA_OPS = (OP_PREFILL, OP_CHUNK, OP_DECODE, OP_PREFILL_SP, OP_ENCODE,
-                OP_EMBED, OP_RAGGED)
+                OP_EMBED, OP_RAGGED, OP_SPEC)
 
     wire_seq = 0
     while max_steps is None or steps < max_steps:
@@ -1140,11 +1168,29 @@ def _replay(rt, op, a, b, payload):
          ring_len, is_first, append, slot_ids, seed_rows, pt, temp, tk,
          tp, pen, pres, freq, seeds, key_data) = payload
         key = jnp.asarray(key_data, jnp.uint32)
-        toks, rt.kc, rt.vc, rt.recent = ModelRuntime._dispatch_ragged(
-            rt, T_pad, tokens, tok_seq, tok_pos, write_slots, q_start,
-            q_len, kv_len, ring_len, is_first, append, seed_rows, slot_ids,
-            pt, temp, tk, tp, pen, pres, freq, seeds, key)
-        return (toks, rt.kc, rt.vc, rt.recent)
+        # No verify spans on this wire shape: is_spec is identically
+        # zero on every host (k_cap=0 compiles the 1-column output).
+        is_spec = np.zeros_like(q_start)
+        toks, n_emit, rt.kc, rt.vc, rt.recent = \
+            ModelRuntime._dispatch_ragged(
+                rt, T_pad, 0, tokens, tok_seq, tok_pos, write_slots,
+                q_start, q_len, kv_len, ring_len, is_first, append,
+                is_spec, seed_rows, slot_ids, pt, temp, tk, tp, pen,
+                pres, freq, seeds, key)
+        return (toks, n_emit, rt.kc, rt.vc, rt.recent)
+    elif op == OP_SPEC:
+        T_pad, k_cap = a, b
+        (tokens, tok_seq, tok_pos, write_slots, q_start, q_len, kv_len,
+         ring_len, is_first, append, is_spec, slot_ids, seed_rows, pt,
+         temp, tk, tp, pen, pres, freq, seeds, key_data) = payload
+        key = jnp.asarray(key_data, jnp.uint32)
+        toks, n_emit, rt.kc, rt.vc, rt.recent = \
+            ModelRuntime._dispatch_ragged(
+                rt, T_pad, k_cap, tokens, tok_seq, tok_pos, write_slots,
+                q_start, q_len, kv_len, ring_len, is_first, append,
+                is_spec, seed_rows, slot_ids, pt, temp, tk, tp, pen,
+                pres, freq, seeds, key)
+        return (toks, n_emit, rt.kc, rt.vc, rt.recent)
     elif op == OP_ENCODE:
         B, bucket = a, b
         tokens, lens = payload

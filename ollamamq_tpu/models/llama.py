@@ -253,7 +253,7 @@ def forward_ragged(
     tok_seq: jnp.ndarray,  # [T] int32 sequence (batch row) per token
     tok_pos: jnp.ndarray,  # [T] int32 kv position per token (-1 = pad)
     write_slots: jnp.ndarray,  # [T] int32 flat cache slot per token
-    last_idx: jnp.ndarray,  # [B] int32 stream index of each seq's last token
+    out_idx: jnp.ndarray,  # [B] or [B, O] int32 stream indices to read logits at
     k_cache: jnp.ndarray,  # [L, S, Hk, hd] (donated)
     v_cache: jnp.ndarray,
     page_table: jnp.ndarray,  # [B, max_pages]
@@ -269,9 +269,13 @@ def forward_ragged(
     no per-sequence bucket padding. Each layer writes the stream's K/V
     into its pages, then every token attends causally over its own
     sequence's paged context (generalizes forward_prefill_chunk to many
-    sequences and forward_decode to multi-token spans). Returns
-    (last-token logits [B, V], caches'); padding rows (q_len == 0) yield
-    garbage logits the caller ignores.
+    sequences and forward_decode to multi-token spans). `out_idx` names
+    the stream positions whose logits leave the forward: a [B] vector
+    (each sequence's last token — the classic shape) returns [B, V];
+    a [B, O] matrix (speculative verification reads a logit at EVERY
+    draft position of a span) returns [B, O, V]. Padding rows
+    (q_len == 0) yield garbage logits the caller ignores. Returns
+    (logits, caches').
     """
     T = tokens.shape[0]
     x = params["embed"][tokens].astype(params["embed"].dtype)[None]  # [1,T,D]
@@ -298,8 +302,12 @@ def forward_ragged(
     x, (k_cache, v_cache) = jax.lax.scan(
         body, x, (params["layers"], k_cache, v_cache)
     )
-    x_last = x[0][last_idx]  # [B, D]
-    logits = _logits(params, cfg, x_last[None])[0]  # [B, V]
+    if out_idx.ndim == 1:
+        x_last = x[0][out_idx]  # [B, D]
+        logits = _logits(params, cfg, x_last[None])[0]  # [B, V]
+    else:
+        x_last = x[0][out_idx]  # [B, O, D]
+        logits = _logits(params, cfg, x_last)  # [B, O, V]
     return logits, k_cache, v_cache
 
 

@@ -219,6 +219,29 @@ def maybe_apply_penalties(logits, recent, repeat, presence, frequency,
     return apply_penalties(logits, recent, repeat, presence, frequency)
 
 
+def accept_prefix(
+    draft: jnp.ndarray,  # [B, K] int32 proposed draft tokens
+    greedy: jnp.ndarray,  # [B, K] int32 model argmax at each draft's position
+    draft_len: jnp.ndarray,  # [B] int32 valid drafts per row (0 = none)
+) -> jnp.ndarray:
+    """[B] number of leading draft tokens the model verified.
+
+    Greedy speculative verification: draft j is accepted iff every draft
+    before it was accepted AND the model's argmax at its position equals
+    it — the longest matching prefix, computed as the sum of a running
+    product over the match mask (the first mismatch zeroes everything
+    after it). Positions at or past draft_len never count, so k=0 rows
+    answer 0. Exact: accepting this prefix and then taking the model's
+    own next token reproduces the non-speculative greedy stream
+    byte-for-byte."""
+    K = draft.shape[1]
+    if K == 0:
+        return jnp.zeros(draft.shape[0], jnp.int32)
+    valid = jnp.arange(K)[None, :] < draft_len[:, None]
+    match = ((draft == greedy) & valid).astype(jnp.int32)
+    return jnp.sum(jnp.cumprod(match, axis=1), axis=1).astype(jnp.int32)
+
+
 def per_row_keys(
     key: jax.Array,  # engine-stream key for this dispatch
     seeds: jnp.ndarray,  # [B] int32; >0 = request-provided seed

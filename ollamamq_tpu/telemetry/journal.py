@@ -49,6 +49,9 @@ EVENTS = (
     "batch",          # prefill batch composed (slots/bucket/occupancy)
     "chunk",          # one chunked-prefill piece dispatched
     "install",        # slot activated: request entered the decode batch
+    "speculate",      # drafts composed into a verify span for a slot
+    "spec_verify",    # verify outcome: drafts proposed vs accepted
+    "spec_rollback",  # rejected drafts' KV page claim released
     "preempt",        # victim evicted for recompute under KV pressure
     "kv_stall",       # page growth failed; slot holds a reservation
     "requeue",        # returned to the FRONT of its user's queue
@@ -81,9 +84,20 @@ EVENT_FIELDS: Dict[str, Tuple[tuple, tuple]] = {
     # the padding-waste scoreboard.
     "batch": (("slots", "batch_size", "tokens", "occupancy"),
               ("reqs", "pending", "free_pages", "bucket", "mode",
-               "padded_tokens", "n_prefill", "n_decode")),
+               "padded_tokens", "n_prefill", "n_decode", "n_spec",
+               "spec_tokens", "spec_accepted")),
     "chunk": (("slot", "pos"), ("tokens", "cached")),
     "install": (("slot",), ("n_prompt",)),
+    # Speculation decisions carry their inputs/outcomes: `k` drafts from
+    # `source` were composed (speculate); `accepted` of `proposed` drafts
+    # survived greedy verification (spec_verify — accepted <= proposed is
+    # a checked invariant); the rollback releases the rejected tail's
+    # page claim with the allocator post-state, so page conservation
+    # (free+used+cached==pool) stays checkable through speculation.
+    "speculate": (("slot", "k"), ("source",)),
+    "spec_verify": (("slot", "proposed", "accepted"), ("rolled_back",)),
+    "spec_rollback": (("slot", "kv_before", "kv_after", "freed",
+                       "free", "used", "cached", "pool"), ()),
     "preempt": (("slot", "why"),
                 ("n", "free_pages", "victim_served", "vip")),
     "kv_stall": (("slot",), ("free_pages", "need")),
@@ -336,6 +350,23 @@ def explain(rec: dict) -> str:
                 f"({rec.get('tokens', '?')} tokens, slot {rec.get('slot')})")
     if kind == "install":
         return f"{who} installed in slot {rec.get('slot', '?')}"
+    if kind == "speculate":
+        return (f"{who} speculating {rec.get('k', '?')} draft token(s) in "
+                f"slot {rec.get('slot', '?')} "
+                f"(source {rec.get('source', 'ngram')})")
+    if kind == "spec_verify":
+        return (f"{who} verified speculation in slot {rec.get('slot', '?')}"
+                f": accepted {rec.get('accepted', '?')}/"
+                f"{rec.get('proposed', '?')} draft(s)"
+                + (f", rolled back {rec['rolled_back']}"
+                   if rec.get("rolled_back") else ""))
+    if kind == "spec_rollback":
+        return (f"{who} speculative rollback in slot {rec.get('slot', '?')}"
+                f": kv {rec.get('kv_before', '?')} -> "
+                f"{rec.get('kv_after', '?')}, {rec.get('freed', '?')} "
+                f"page(s) freed (free={rec.get('free')}, "
+                f"used={rec.get('used')}, cached={rec.get('cached')}, "
+                f"pool={rec.get('pool')})")
     if kind == "preempt":
         s = (f"{who} preempted from slot {rec.get('slot', '?')} "
              f"({rec.get('why', '?')}, n={rec.get('n', '?')})")
@@ -407,14 +438,17 @@ def check_invariants(records: List[dict],
     """Returns violation strings (empty = clean). Checked invariants:
 
       1. pages conserved — every page event's post-state satisfies
-         free + used + cached == pool;
+         free + used + cached == pool (speculative rollbacks included:
+         rejected-draft page releases must balance too);
       2. no slot double-assignment — an install on a slot whose observed
          holder never finished/preempted is a scheduler bug;
       3. preempt victim is never the VIP;
       4. shed only when bounds exceeded — a queue_full/user_queue_full
          shed whose recorded depth is below the recorded cap lied;
       5. no admitted request starves past `starve_after` prefill batches
-         without progress (install/finish/requeue/retry/shed/preempt).
+         without progress (install/finish/requeue/retry/shed/preempt);
+      6. speculation never accepts more than it proposed — a spec_verify
+         with accepted > proposed fabricated tokens.
     """
     bad: List[str] = []
     # (model, slot) -> req_id currently observed holding it.
@@ -428,7 +462,8 @@ def check_invariants(records: List[dict],
         kind = r.get("kind")
         seq = r.get("seq", "?")
         rid = r.get("req_id")
-        if kind in ("page_alloc", "page_free", "page_evict"):
+        if kind in ("page_alloc", "page_free", "page_evict",
+                    "spec_rollback"):
             free, used = r.get("free"), r.get("used")
             cached, pool = r.get("cached"), r.get("pool")
             if None not in (free, used, cached, pool) \
@@ -437,6 +472,12 @@ def check_invariants(records: List[dict],
                     f"seq {seq}: pages not conserved after {kind}: "
                     f"free {free} + used {used} + cached {cached} "
                     f"!= pool {pool}")
+        elif kind == "spec_verify":
+            prop, acc = r.get("proposed"), r.get("accepted")
+            if None not in (prop, acc) and acc > prop:
+                bad.append(
+                    f"seq {seq}: speculation accepted {acc} > proposed "
+                    f"{prop} draft(s) for req {rid}")
         elif kind == "install" and (r.get("slot") or 0) >= 0:
             # slot -1 = an unslotted runtime (FakeRuntime): nothing to
             # double-assign.

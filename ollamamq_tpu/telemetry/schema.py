@@ -129,8 +129,22 @@ RETRIES_TOTAL = REGISTRY.counter(
 DEADLINE_DROPS_TOTAL = REGISTRY.counter(
     "ollamamq_deadline_drops_total",
     "Requests dropped because their per-request deadline expired "
-    "(at admission, before prefill dispatch, or at preemption "
-    "re-admission)", labels=("model",))
+    "(at admission, before prefill dispatch, before composing a "
+    "speculative verify span, or at preemption re-admission)",
+    labels=("model",))
+
+# -- speculative decoding (--spec; n-gram draft + ragged verify) -----------
+SPEC_TOKENS_TOTAL = REGISTRY.counter(
+    "ollamamq_spec_tokens_total",
+    "Speculative draft tokens by outcome: proposed (composed into a "
+    "verify span), accepted (matched the model's greedy argmax and "
+    "emitted), rejected (KV pages rolled back)",
+    labels=("model", "outcome"))
+SPEC_ACCEPT_RATE = REGISTRY.gauge(
+    "ollamamq_spec_accept_rate",
+    "Accepted / proposed speculative draft tokens since start (0..1); "
+    "the per-user auto-throttle (--spec-min-accept) keys off the same "
+    "accounting", labels=("model",))
 
 
 def total_shed() -> float:

@@ -25,10 +25,11 @@ Plan file schema (JSON, validated loudly at startup — a malformed
 Each rule names ONE site and ONE trigger:
 
   site     where the fault fires — a dispatch seam ("prefill", "chunk",
-           "sp_prefill", "ragged" for the mixed-batch dispatch, "decode",
-           "embed", "encode", "step" for the fake runtime) or an
-           allocation seam ("alloc" = admission page alloc, "extend" =
-           decode-time page growth).
+           "sp_prefill", "ragged" for the mixed-batch dispatch,
+           "spec_verify" for a mixed dispatch carrying speculative
+           verify spans, "decode", "embed", "encode", "step" for the
+           fake runtime) or an allocation seam ("alloc" = admission
+           page alloc, "extend" = decode-time page growth).
   kind     "exception"  -> the dispatch raises FaultInjected (the
                            engine's retry/containment path handles it);
            "slow"       -> the dispatch sleeps delay_s first (stall
@@ -63,8 +64,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-SITES = ("prefill", "chunk", "sp_prefill", "ragged", "decode", "embed",
-         "encode", "step", "alloc", "extend")
+SITES = ("prefill", "chunk", "sp_prefill", "ragged", "spec_verify",
+         "decode", "embed", "encode", "step", "alloc", "extend")
 KINDS = ("exception", "slow", "alloc_fail", "device_loss")
 
 _RULE_KEYS = {"site", "kind", "at", "every", "p", "times", "delay_s",

@@ -78,6 +78,10 @@ _MINIMAL = {
                   occupancy=0.5),
     "chunk": dict(slot=0, pos=64, tokens=32),
     "install": dict(slot=1, n_prompt=7),
+    "speculate": dict(slot=1, k=4, source="ngram"),
+    "spec_verify": dict(slot=1, proposed=4, accepted=2, rolled_back=2),
+    "spec_rollback": dict(slot=1, kv_before=20, kv_after=18, freed=1,
+                          free=11, used=19, cached=1, pool=31),
     "preempt": dict(slot=2, why="kv_pressure", n=1, free_pages=0,
                     victim_served=9, vip="alice"),
     "kv_stall": dict(slot=0, free_pages=0),
