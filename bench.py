@@ -36,7 +36,7 @@ def _emit_error(msg: str, **extras) -> None:
     }), flush=True)
 
 
-def _fallback_argv(model: str, attention: str = "ragged",
+def _fallback_argv(model: str, dtypes=("bfloat16", "bfloat16"),
                    cpu: bool = True) -> list:
     """argv for a fallback run: a fresh subprocess (the wedged tunnel has
     this process's backend thread stuck forever) with a smoke workload —
@@ -49,12 +49,12 @@ def _fallback_argv(model: str, attention: str = "ragged",
         + ["--model", model, "--slots", "4", "--prompt-len", "32",
            "--steps", "16", "--warmup-steps", "4", "--chunk", "4",
            "--ttft-samples", "2", "--sweep-chunks", "",
-           "--attention", attention,
+           "--weights-dtype", dtypes[0], "--kv-dtype", dtypes[1],
            "--speculative", "3",
            "--shared-prefix", "2", "--shared-prefix-len", "64",
            "--shared-prefix-tail", "16",
            "--slo-burst", "2", "--slo-burst-size", "4",
-           "--overload", "16",
+           "--overload", "16", "--density", "8",
            "--init-timeout", "300"]
 
 
@@ -82,7 +82,7 @@ def _run_fallback(argv: list, env: dict, timeout: float, tag: dict,
 
 
 def _partial_pod_fallback(model: str, reason: str,
-                          attention: str = "ragged") -> bool:
+                          dtypes=("bfloat16", "bfloat16")) -> bool:
     """Single-host TPU fallback for a wedged POD init: re-run the smoke
     workload in a child whose env restricts the topology to this host's
     chips (no cross-host tunnel to wedge). A partial-pod number beats a
@@ -103,12 +103,13 @@ def _partial_pod_fallback(model: str, reason: str,
               "JAX_PROCESS_ID"):
         env.pop(k, None)
     return _run_fallback(
-        _fallback_argv(model, attention, cpu=False), env, 1800,
+        _fallback_argv(model, dtypes, cpu=False), env, 1800,
         {"partial_pod": True, "fallback": True, "fallback_reason": reason},
         "partial-pod")
 
 
-def _cpu_fallback(model: str, reason: str, attention: str = "ragged") -> bool:
+def _cpu_fallback(model: str, reason: str,
+                  dtypes=("bfloat16", "bfloat16")) -> bool:
     """Run the CPU-mesh fallback and emit ITS measurement, clearly tagged
     platform=cpu + fallback_reason, so a wedged TPU tunnel still yields a
     non-empty scoreboard line. Returns True if a line was emitted."""
@@ -117,16 +118,17 @@ def _cpu_fallback(model: str, reason: str, attention: str = "ragged") -> bool:
     env = dict(os.environ, OLLAMAMQ_BENCH_NO_FALLBACK="1",
                JAX_PLATFORMS="cpu")
     return _run_fallback(
-        _fallback_argv(model, attention, cpu=True), env, 1200,
+        _fallback_argv(model, dtypes, cpu=True), env, 1200,
         {"platform": "cpu", "fallback": True, "fallback_reason": reason},
         "cpu")
 
 
-def _any_fallback(model: str, reason: str, attention: str = "ragged") -> bool:
+def _any_fallback(model: str, reason: str,
+                  dtypes=("bfloat16", "bfloat16")) -> bool:
     """Fallback ladder for a dead/wedged pod init: single-host TPU first
     (real accelerator numbers), CPU smoke last."""
-    return (_partial_pod_fallback(model, reason, attention)
-            or _cpu_fallback(model, reason, attention))
+    return (_partial_pod_fallback(model, reason, dtypes)
+            or _cpu_fallback(model, reason, dtypes))
 
 
 def _init_devices(retries: int = 3, backoff_s: float = 2.0):
@@ -164,13 +166,24 @@ def main() -> int:
     p.add_argument("--page-size", type=int, default=32,
                    help="KV page size (tokens per page); 32 measured "
                         "faster than 16 on v5e (r3: 1762 vs <1700 tok/s)")
-    p.add_argument("--attention", choices=("ragged", "bucketed"),
-                   default="ragged",
-                   help="batch composition under test: 'ragged' packs "
-                        "prefill spans + decode rows into one token-budget "
-                        "dispatch; 'bucketed' is the legacy padded-bucket "
-                        "oracle — every BENCH record carries this field so "
-                        "A/B rounds are attributable")
+    p.add_argument("--weights-dtype", choices=("bfloat16", "int8"),
+                   default="bfloat16",
+                   help="weight storage dtype under test (int8 = "
+                        "per-channel symmetric, dequant-fused matmuls); "
+                        "every BENCH record carries this field next to "
+                        "'attention'/'spec' so A/B rounds are "
+                        "attributable")
+    p.add_argument("--kv-dtype", choices=("bfloat16", "int8"),
+                   default="bfloat16",
+                   help="KV page dtype under test (int8 = ~2x pages per "
+                        "HBM byte); carried in every BENCH record")
+    p.add_argument("--density", type=int, default=16,
+                   help="requests per leg of the density scenario: the "
+                        "SAME arrival trace against a bf16-KV pool and "
+                        "an int8-KV pool sized to the SAME HBM byte "
+                        "budget — reports concurrent-requests-at-equal-"
+                        "HBM, preemptions/sheds per leg, and the int8-"
+                        "vs-bf16 quality guardrail; 0 disables")
     p.add_argument("--max-batch-tokens", type=int, default=512,
                    help="ragged dispatch token budget")
     p.add_argument("--token-granule", type=int, default=16,
@@ -301,15 +314,17 @@ def main() -> int:
 
         def w():
             if not done.wait(budget):
-                if fallback and _any_fallback(args.model, msg,
-                                              args.attention):
+                if fallback and _any_fallback(args.model, msg, _dtypes):
                     os._exit(exit_code)
-                _emit_error(msg, phase=phase, attention=args.attention,
+                _emit_error(msg, phase=phase, attention="ragged",
+                            weights_dtype=args.weights_dtype,
+                            kv_dtype=args.kv_dtype,
                             spec=args.spec, **extras)
                 os._exit(exit_code)
 
         threading.Thread(target=w, daemon=True).start()
 
+    _dtypes = (args.weights_dtype, args.kv_dtype)
     init_done = threading.Event()
     arm_watchdog(init_done, args.init_timeout, "init", 3,
                  f"device/runtime init exceeded {args.init_timeout:.0f}s "
@@ -319,10 +334,11 @@ def main() -> int:
     except Exception as e:
         init_done.set()
         msg = f"backend init failed: {type(e).__name__}: {e}"
-        if _any_fallback(args.model, msg, args.attention):
+        if _any_fallback(args.model, msg, _dtypes):
             return 3
-        _emit_error(msg, phase="init", attention=args.attention,
-                    spec=args.spec)
+        _emit_error(msg, phase="init", attention="ragged",
+                    weights_dtype=args.weights_dtype,
+                    kv_dtype=args.kv_dtype, spec=args.spec)
         return 3
     # Pages: prompt + generated headroom for every slot. A leg consumes,
     # beyond prompt + steps: one compile dispatch (chunk), timed_decode's
@@ -346,11 +362,12 @@ def main() -> int:
         prefill_buckets=(args.prompt_len,),
         max_new_tokens=10**9,
         decode_steps_per_iter=args.chunk,
-        attention_mode=args.attention,
         max_batch_tokens=args.max_batch_tokens,
         token_granule=args.token_granule,
         spec=args.spec,
         spec_k=args.spec_k,
+        weights_dtype=args.weights_dtype,
+        kv_dtype=args.kv_dtype,
     )
     core = MQCore(None)
     t0 = time.monotonic()
@@ -358,10 +375,11 @@ def main() -> int:
         rt = ModelRuntime(args.model, model_cfg, ecfg)
     except Exception as e:
         msg = f"runtime init failed: {type(e).__name__}: {e}"
-        if _any_fallback(args.model, msg, args.attention):
+        if _any_fallback(args.model, msg, _dtypes):
             return 4
         _emit_error(msg, phase="runtime_init", device=str(dev),
-                    attention=args.attention, spec=args.spec)
+                    attention="ragged", weights_dtype=args.weights_dtype,
+                    kv_dtype=args.kv_dtype, spec=args.spec)
         return 4
     finally:
         init_done.set()  # watchdog covers device + runtime init, not the run
@@ -657,6 +675,20 @@ def main() -> int:
             rt.fault_plan = None
             rt.on_preempt = None
 
+    # density scenario: the SAME arrival trace against a bf16-KV pool
+    # and an int8-KV pool sized to the SAME HBM byte budget — the
+    # quantization PR's acceptance line: ~2x concurrent requests at
+    # equal HBM, fewer preemptions/sheds at the same arrival rate, with
+    # the int8-vs-bf16 quality guardrail and journal invariants in-band.
+    density = None
+    if args.density > 0:
+        try:
+            density = _density_scenario(rt, model_cfg, args, rng, touch)
+        except Exception as e:  # never discard the decode numbers
+            density = {"error": f"{type(e).__name__}: {e}"}
+            print(f"# density scenario failed: {density['error']}",
+                  file=sys.stderr)
+
     # speculative scenario: spec-off vs spec-on decode throughput on a
     # repetitive generation regime (where n-gram drafts verify), plus an
     # accept-rate/auto-throttle readout on the chaotic regime — with the
@@ -692,9 +724,14 @@ def main() -> int:
         "device": str(dev),
         "platform": jax.default_backend(),
         # The A/B matrix cell this record measured: platform above +
-        # batch-composition mode here ride EVERY record (incl. error and
-        # fallback lines), so official rounds are attributable.
-        "attention": args.attention,
+        # batch composition + storage dtypes here ride EVERY record
+        # (incl. error and fallback lines), so official rounds are
+        # attributable. attention is constant since the bucketed oracle
+        # was removed (PR 8) — kept so round-over-round tooling keys on
+        # a stable field set.
+        "attention": "ragged",
+        "weights_dtype": args.weights_dtype,
+        "kv_dtype": args.kv_dtype,
         # Speculative decoding on/off in the engine config under test;
         # the `speculative` scenario below reports its own A/B legs.
         "spec": bool(args.spec),
@@ -733,21 +770,19 @@ def main() -> int:
         result["slo_burst"] = slo_burst
     if overload is not None:
         result["overload"] = overload
+    if density is not None:
+        result["density"] = density
     run_done.set()
     print(json.dumps(result), flush=True)
     return 0
 
 
 def _pump(rt, core, touch, phase):
-    """One admission/prefill tick in whichever batching mode the runtime
-    serves: ragged = one mixed token-budget dispatch (decode rows advance
-    inside it); bucketed = same-bucket batch + one chunk. The one seam
-    every scenario drives, so both modes run the same workloads."""
-    if getattr(rt, "ragged", False):
-        progressed = rt.step_ragged(core)
-    else:
-        progressed = rt.step_prefill(core)
-        progressed = rt.step_chunk(core) or progressed
+    """One admission/prefill tick: the ragged mixed token-budget dispatch
+    (decode rows advance inside it) — the ONE seam every scenario
+    drives. The bucketed-oracle branch this used to carry was removed
+    with --attention=bucketed (single-mesh runtimes are always ragged)."""
+    progressed = rt.step_ragged(core)
     touch(phase)
     return progressed
 
@@ -784,9 +819,8 @@ def _overload_scenario(rt, core, args, rng, touch):
                 rt._finish_slot(s, FinishReason.CANCELLED, core)
 
     drain()
-    # The prefill-path fault targets whichever dispatch shape this mode
-    # actually runs (the ragged mixed dispatch replaces batched prefill).
-    prefill_site = "ragged" if getattr(rt, "ragged", False) else "prefill"
+    # The prefill path IS the ragged mixed dispatch.
+    prefill_site = "ragged"
     plan = FaultPlan([
         # KV pressure: every 5th decode-time page growth "fails",
         # driving the preempt-with-recompute path repeatedly.
@@ -826,6 +860,7 @@ def _overload_scenario(rt, core, args, rng, touch):
 
     shed0, dl0 = shed_count(), deadline_count()
     reqs, shed_at_admission, issued = [], 0, 0
+    peak_active = 0
     t_start = time.monotonic()
     guard = 0
     while True:
@@ -867,6 +902,9 @@ def _overload_scenario(rt, core, args, rng, touch):
             raise RuntimeError(f"engine step escaped containment: "
                                f"{type(e).__name__}: {e}")
         touch("overload")
+        peak_active = max(peak_active,
+                          sum(1 for r in rt.slot_req if r is not None)
+                          + len(rt.chunking))
         unresolved = [r for r in reqs if not r.stats.finished_at]
         if issued >= n_total and not unresolved:
             break
@@ -902,9 +940,20 @@ def _overload_scenario(rt, core, args, rng, touch):
     served = len(ttfts)
     rt.journal = None  # detach before later scenarios reuse this runtime
     jrecs = journal.tail(None)
+    # Density readout: how many of THIS workload's requests the pool
+    # could hold concurrently at the configured HBM (pages per request =
+    # prompt + generation headroom), next to the observed peak — the
+    # quantized-vs-bf16 A/B line reads straight off these when two
+    # rounds differ only in --kv-dtype.
+    pages_per_req = rt.alloc.pages_needed(prompt_len + max_new)
     return {
         "requests": n_total,
         "queue_cap": qcap,
+        "kv_dtype": rt.kv_dtype,
+        "weights_dtype": rt.weights_dtype,
+        "peak_active": peak_active,
+        "concurrent_capacity_at_hbm": (rt.alloc.num_pages - 1)
+        // max(1, pages_per_req),
         "journal": batch_stats(jrecs),
         "invariant_violations": len(check_invariants(jrecs)),
         "elapsed_s": round(elapsed_s, 3),
@@ -923,6 +972,177 @@ def _overload_scenario(rt, core, args, rng, touch):
                                         int(0.99 * served))], 1)
                         if served else None),
         "silent_truncations": silent_truncations,
+    }
+
+
+def _density_scenario(rt, model_cfg, args, rng, touch):
+    """Serving-density A/B at EQUAL HBM: size a bf16-KV pool to hold
+    only ~half the offered concurrency, compute its byte budget, size an
+    int8-KV pool to the SAME budget (more pages per byte), and drive the
+    identical arrival trace through both. The int8 leg must hold ~2x the
+    concurrent requests (2*hd/(hd+4) exactly — fp32 scale rows are the
+    overhead) and therefore preempt/shed less at the same arrival rate.
+    The int8-vs-bf16 weight-quality guardrail (teacher-forced greedy
+    token match + max logit error) and the journal invariant checker run
+    in-band; `gate` summarizes pass/fail for the density regression."""
+    import time
+
+    from ollamamq_tpu.config import EngineConfig
+    from ollamamq_tpu.core import MQCore
+    from ollamamq_tpu.engine import kv_cache as kvc
+    from ollamamq_tpu.engine.engine import ModelRuntime, drop_expired
+    from ollamamq_tpu.engine.request import Request
+    from ollamamq_tpu.models import weights as weights_mod
+    from ollamamq_tpu.ops.sampling import SamplingParams
+    from ollamamq_tpu.telemetry.journal import Journal, check_invariants
+
+    n_total = args.density
+    slots = min(args.slots, 4)
+    prompt_len = min(args.prompt_len, 32)
+    max_new = 8
+    ps = rt.ecfg.page_size
+    pages_per_req = -(-(prompt_len + max_new) // ps) + 1
+    # bf16 pool: room for ~half the decode batch -> the trace MUST hit
+    # the ceiling, so preemptions register on the scoreboard.
+    pages_bf16 = max(2, (slots * pages_per_req) // 2) + 1
+    budget = pages_bf16 * kvc.kv_page_bytes(model_cfg, ps,
+                                            kv_dtype="bfloat16")
+    pages_int8 = budget // kvc.kv_page_bytes(model_cfg, ps,
+                                             kv_dtype="int8")
+    hd = model_cfg.head_dim
+    expected_ratio = 2 * hd / (hd + 4)
+
+    def run_leg(kv_dtype, num_pages):
+        ecfg = EngineConfig(
+            model=args.model, max_slots=slots, num_pages=num_pages + 1,
+            page_size=ps, max_pages_per_seq=pages_per_req + 2,
+            prefill_buckets=(max(32, prompt_len),), max_new_tokens=max_new,
+            decode_steps_per_iter=2,
+            max_batch_tokens=max(64, slots * 16), token_granule=16,
+            weights_dtype=args.weights_dtype, kv_dtype=kv_dtype,
+            preempt=True, preempt_max=2, seed=rt.ecfg.seed,
+        )
+        leg = ModelRuntime(args.model, model_cfg, ecfg,
+                           preloaded_params=rt.params)
+        leg.tokenizer.eos_id = -1  # full-length streams: equal pressure
+        journal = Journal(capacity=65536)
+        leg.journal = journal
+        core = MQCore(None)
+        recompute = {"tokens": 0}
+
+        def requeue(req):
+            if req.expired():
+                drop_expired(req, core, leg.name)
+                return False
+            recompute["tokens"] += len(req.prompt_tokens)
+            leg.pending_prefill.appendleft(req)
+            return True
+
+        leg.on_preempt = requeue
+        trace = __import__("numpy").random.default_rng(1234)
+        hi = min(model_cfg.vocab_size, 30000)
+        reqs, issued, peak_active, guard = [], 0, 0, 0
+        t0 = time.monotonic()
+        while True:
+            while issued < n_total and len(leg.pending_prefill) < 4:
+                prompt = trace.integers(3, hi, size=prompt_len).tolist()
+                req = Request(60000 + issued, f"dn{issued % 4}", leg.name,
+                              prompt, SamplingParams(max_tokens=max_new))
+                req._inc_decode = leg.tokenizer.make_incremental_decoder()
+                reqs.append(req)
+                leg.pending_prefill.append(req)
+                issued += 1
+            progressed = leg.step_ragged(core)
+            if any(r is not None for r in leg.slot_req):
+                progressed = (leg.step_decode(core, k_steps=2) > 0) \
+                    or progressed
+            touch("density")
+            peak_active = max(peak_active,
+                              sum(1 for r in leg.slot_req if r is not None)
+                              + len(leg.chunking))
+            unresolved = [r for r in reqs if not r.stats.finished_at]
+            if issued >= n_total and not unresolved:
+                break
+            guard += 1
+            if guard > 3000 * n_total:
+                raise RuntimeError(
+                    f"density leg {kv_dtype} wedged: "
+                    f"{len(unresolved)} unresolved")
+            if not progressed and unresolved:
+                time.sleep(0.001)
+        outcomes = {}
+        for r in reqs:
+            item = None
+            for it in r.stream.drain():
+                if it.kind in ("done", "error"):
+                    item = it
+            reason = (item.finish_reason.value
+                      if item is not None and item.finish_reason else "none")
+            outcomes[reason] = outcomes.get(reason, 0) + 1
+        jrecs = journal.tail(None)
+        leg.journal = None
+        return {
+            "kv_dtype": kv_dtype,
+            "pages": num_pages,
+            "kv_pool_bytes": leg.kv_bytes,
+            "concurrent_capacity_at_hbm": num_pages // pages_per_req,
+            "peak_active": peak_active,
+            "preemptions": leg.preempt_count,
+            "kv_exhausted": outcomes.get("kv_exhausted", 0),
+            "recompute_tokens": recompute["tokens"],
+            "outcomes": outcomes,
+            "elapsed_s": round(time.monotonic() - t0, 3),
+            "invariant_violations": len(check_invariants(jrecs)),
+        }
+
+    bf16 = run_leg("bfloat16", pages_bf16)
+    int8 = run_leg("int8", pages_int8)
+
+    # Weight-quality guardrail: int8 tree vs its bf16 source. Reuses the
+    # runtime-under-test's params for whichever side it already is.
+    guardrail = None
+    try:
+        if args.weights_dtype == "int8":
+            base = weights_mod.load_params(model_cfg, None,
+                                           seed=rt.ecfg.seed)
+            qp = rt.params
+        else:
+            base = rt.params
+            qp = weights_mod.quantize_params_int8(rt.params, model_cfg)
+        guardrail = weights_mod.quant_guardrail(
+            model_cfg, base_params=base, q_params=qp,
+            seed=rt.ecfg.seed, prompt_len=8, steps=4)
+        touch("density")
+    except Exception as e:
+        guardrail = {"error": f"{type(e).__name__}: {e}"}
+
+    ratio = int8["concurrent_capacity_at_hbm"] / max(
+        1, bf16["concurrent_capacity_at_hbm"])
+    reasons = []
+    if ratio < 0.85 * expected_ratio:
+        reasons.append(f"capacity ratio {ratio:.2f} under "
+                       f"{0.85 * expected_ratio:.2f}")
+    if int8["preemptions"] > bf16["preemptions"]:
+        reasons.append("int8 leg preempted MORE than bf16 at equal HBM")
+    if int8["invariant_violations"] or bf16["invariant_violations"]:
+        reasons.append("journal invariant violations")
+    if (isinstance(guardrail, dict)
+            and guardrail.get("token_match_rate", 1.0) < 0.8):
+        reasons.append("quality guardrail under 0.8 token match")
+    return {
+        "requests": n_total,
+        "hbm_budget_bytes": budget,
+        "page_bytes_bf16": kvc.kv_page_bytes(model_cfg, ps,
+                                             kv_dtype="bfloat16"),
+        "page_bytes_int8": kvc.kv_page_bytes(model_cfg, ps,
+                                             kv_dtype="int8"),
+        "capacity_ratio": round(ratio, 3),
+        "expected_ratio": round(expected_ratio, 3),
+        "bf16": bf16,
+        "int8": int8,
+        "guardrail": guardrail,
+        "gate": "pass" if not reasons else "fail",
+        "gate_reasons": reasons,
     }
 
 
@@ -951,7 +1171,7 @@ def _speculative_scenario(rt, core, args, rng, touch):
     from ollamamq_tpu.ops.sampling import SamplingParams
 
     if not getattr(rt, "ragged", False):
-        return {"skipped": "speculation needs --attention=ragged"}
+        return {"skipped": "speculation needs the ragged path (pp=1)"}
     n_req = min(args.speculative, args.slots)
     # Floor high enough that the spec-on leg sees several STEADY verify
     # dispatches after its compile ticks are excluded — a 2-tick sample

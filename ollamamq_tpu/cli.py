@@ -52,14 +52,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-new-tokens", type=int, default=256)
     p.add_argument("--decode-steps", type=int, default=8,
                    help="decode steps fused per dispatch when idle")
-    p.add_argument("--attention", choices=("ragged", "bucketed"),
-                   default="ragged",
-                   help="batch composition: 'ragged' (default) packs any "
-                        "mix of prefill spans and decode tokens into one "
-                        "token-budget dispatch (no bucket padding); "
-                        "'bucketed' keeps the legacy same-bucket padded "
-                        "batches as a byte-identical diff-testing oracle "
-                        "for one release")
+    p.add_argument("--weights-dtype", choices=("bfloat16", "int8"),
+                   default="bfloat16",
+                   help="weight storage dtype: 'int8' quantizes at load "
+                        "time (per-channel symmetric, fp32 scales, "
+                        "dequant fused into the matmuls) — roughly "
+                        "halves weight HBM and the bytes every weight-"
+                        "streaming-bound dispatch reads")
+    p.add_argument("--kv-dtype", choices=("bfloat16", "int8"),
+                   default="bfloat16",
+                   help="KV page dtype: 'int8' shrinks every page ~2x "
+                        "(per-page-row fp32 scales stored alongside the "
+                        "pool), so ~2x concurrent requests fit the same "
+                        "HBM; invalid combinations (MoE weights, "
+                        "--pp/--sp KV) fail at startup")
     p.add_argument("--max-batch-tokens", type=int, default=512,
                    help="token budget of one ragged dispatch (decode rows "
                         "+ prefill-span tokens); clamped up so a full "
@@ -270,6 +276,18 @@ def main(argv=None) -> int:
         log.error("--journal-rotate-mb / --log-rotate-mb must be >= 0 "
                   "(0 disables rotation)")
         return 2
+    # Quantization flags fail fast BEFORE any device/runtime work: an
+    # unsupported combination must kill the process at startup, not at
+    # the first dispatch (same validator the SPMD worker and the
+    # runtimes run).
+    from ollamamq_tpu.config import validate_quant_config
+
+    quant_err = validate_quant_config(
+        args.weights_dtype, args.kv_dtype, pp=args.pp, sp=args.sp,
+        model_names=[m.strip() for m in args.models.split(",") if m.strip()])
+    if quant_err is not None:
+        log.error("%s", quant_err)
+        return 2
     if args.fault_plan:
         # Schema-check the plan BEFORE any engine/device work: a typo'd
         # chaos plan must fail the process at startup, not mid-traffic.
@@ -331,7 +349,6 @@ def main(argv=None) -> int:
         max_pages_per_seq=args.max_pages_per_seq,
         max_new_tokens=args.max_new_tokens,
         decode_steps_per_iter=args.decode_steps,
-        attention_mode=args.attention,
         max_batch_tokens=args.max_batch_tokens,
         token_granule=args.token_granule,
         spec=args.spec,
@@ -358,6 +375,8 @@ def main(argv=None) -> int:
         journal_file=args.journal_file or None,
         journal_rotate_mb=args.journal_rotate_mb,
         journal_keep=args.journal_keep,
+        weights_dtype=args.weights_dtype,
+        kv_dtype=args.kv_dtype,
     )
     fairness = Fairness.TOKENS if args.token_fairness else Fairness.REQUESTS
 

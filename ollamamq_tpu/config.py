@@ -208,18 +208,17 @@ class EngineConfig:
     # Max pages a single sequence may hold (=> max context length).
     max_pages_per_seq: int = 16
     # Prefill length buckets (padded; each bucket compiles once). Used by
-    # the bucketed oracle path (attention_mode="bucketed") and, in both
-    # modes, as the chunk ceiling for the sequence-parallel prefill
-    # hand-off.
+    # the pipeline-parallel (pp > 1) prefill path and, in both modes, as
+    # the chunk ceiling for the sequence-parallel prefill hand-off. The
+    # legacy user-facing bucketed oracle (--attention=bucketed) was
+    # removed one release after the ragged path shipped, as scheduled.
     prefill_buckets: tuple = (32, 64, 128, 256, 512, 1024, 2048)
     # -- ragged mixed-batch attention ----------------------------------------
-    # "ragged" (default): ONE token-budget dispatch packs any mix of
-    # variable-length prefill spans and decode tokens into a flattened
-    # stream (Pallas ragged kernel on TPU, jnp twin elsewhere) — no
-    # power-of-two bucket padding. "bucketed": the legacy same-bucket
-    # batch composition, kept for one release as a byte-identical
-    # diff-testing oracle (--attention=bucketed).
-    attention_mode: str = "ragged"
+    # ONE token-budget dispatch packs any mix of variable-length prefill
+    # spans and decode tokens into a flattened stream (Pallas ragged
+    # kernel on TPU, jnp twin elsewhere) — no power-of-two bucket
+    # padding. pp > 1 runtimes serve the stage-scheduled bucketed
+    # prefill path instead (the ragged forward is single-stage).
     # Token budget of one ragged dispatch: decode rows (1 token per
     # active slot) plus as many prefill-tail tokens as fit. Clamped up
     # to max_slots + token_granule so a full decode batch always fits.
@@ -277,6 +276,17 @@ class EngineConfig:
     # weights, so FEWER can win) — sweep on hardware.
     pp_microbatches: Optional[int] = None
     dtype: str = "bfloat16"
+    # -- int8 quantization (serving density) ---------------------------------
+    # weights_dtype="int8": per-channel symmetric int8 weights quantized
+    # at load time (scales fp32, dequant fused into the matmuls, bf16
+    # accumulation) — roughly halves weight HBM and the bytes every
+    # weight-streaming-bound dispatch pays. kv_dtype="int8": int8 KV
+    # pages with per-page-row fp32 scales stored alongside the pool —
+    # every page shrinks ~2x, so ~2x concurrent requests fit the same
+    # HBM. Invalid combinations (MoE weights, pp/sp KV) fail fast at
+    # startup via validate_quant_config.
+    weights_dtype: str = "bfloat16"
+    kv_dtype: str = "bfloat16"
     seed: int = 0
     # Telemetry: finished request traces kept for GET /debug/trace
     # (Chrome trace-event export); in-flight traces are always exported.
@@ -325,3 +335,35 @@ class EngineConfig:
     @property
     def max_context(self) -> int:
         return self.max_pages_per_seq * self.page_size
+
+
+QUANT_DTYPES = ("bfloat16", "int8")
+
+
+def validate_quant_config(weights_dtype: str, kv_dtype: str,
+                          pp: int = 1, sp: int = 1,
+                          model_names=()) -> Optional[str]:
+    """Fail-fast validation of the quantization flags BEFORE any device
+    work: returns an error string (None = valid). One definition shared
+    by the CLI, the SPMD worker entry, and ModelRuntime so a typo'd or
+    unsupported combination can never reach the first dispatch."""
+    if weights_dtype not in QUANT_DTYPES:
+        return (f"--weights-dtype must be one of {QUANT_DTYPES}, "
+                f"got {weights_dtype!r}")
+    if kv_dtype not in QUANT_DTYPES:
+        return f"--kv-dtype must be one of {QUANT_DTYPES}, got {kv_dtype!r}"
+    if kv_dtype == "int8" and pp > 1:
+        return ("--kv-dtype=int8 needs the ragged attention path; pp > 1 "
+                "runtimes serve the stage-scheduled bucketed prefill whose "
+                "pipeline forwards read bf16 pages")
+    if kv_dtype == "int8" and sp > 1:
+        return ("--kv-dtype=int8 is unsupported with sequence-parallel "
+                "prefill (its all-layer KV scatter bypasses the quantized "
+                "page writer)")
+    if weights_dtype == "int8":
+        for name in model_names:
+            cfg = get_model_config(name)
+            if cfg is not None and cfg.num_experts:
+                return (f"--weights-dtype=int8 does not cover MoE expert "
+                        f"stacks (model {name}); load it in bfloat16")
+    return None

@@ -38,7 +38,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ollamamq_tpu.config import EngineConfig, ModelConfig, get_model_config, smart_match
+from ollamamq_tpu.config import (EngineConfig, ModelConfig,
+                                 get_model_config, smart_match,
+                                 validate_quant_config)
 from ollamamq_tpu.core import MQCore, Fairness, Family
 from ollamamq_tpu.core.mqcore import BlockedError, StuckQueue
 from ollamamq_tpu.engine import kv_cache as kvc
@@ -51,7 +53,8 @@ from ollamamq_tpu.ops.sampling import (accept_prefix, maybe_apply_penalties,
 from ollamamq_tpu.parallel import pipeline
 from ollamamq_tpu.parallel.mesh import (make_mesh, replica_submesh,
                                         validate_tp_for_model)
-from ollamamq_tpu.parallel.sharding import kv_cache_spec, shard_params
+from ollamamq_tpu.parallel.sharding import (kv_cache_spec, kv_scale_spec,
+                                            shard_params)
 from ollamamq_tpu.telemetry import mfu as mfu_model
 from ollamamq_tpu.telemetry import schema as tm
 from ollamamq_tpu.telemetry.journal import Journal
@@ -301,6 +304,18 @@ class ModelRuntime:
         self.mesh = mesh
         self.dtype = dtype
         self.tokenizer = load_tokenizer(checkpoint_path)
+        # Int8 quantization (weights and/or KV pages): validated here
+        # too — tests and embedders construct runtimes directly, and an
+        # unsupported combination must fail at build, not first dispatch.
+        _pp_probe = dict(mesh.shape).get("pipe", 1) if mesh is not None else 1
+        _sp_probe = dict(mesh.shape).get("seq", 1) if mesh is not None else 1
+        err = validate_quant_config(
+            engine_cfg.weights_dtype, engine_cfg.kv_dtype,
+            pp=_pp_probe, sp=_sp_probe, model_names=(name,))
+        if err is not None:
+            raise ValueError(err)
+        self.weights_dtype = engine_cfg.weights_dtype
+        self.kv_dtype = engine_cfg.kv_dtype
         if mesh is not None and mesh.shape.get("tensor", 1) > 1:
             validate_tp_for_model(
                 mesh.shape["tensor"], model_cfg.num_kv_heads, model_cfg.num_heads
@@ -343,7 +358,8 @@ class ModelRuntime:
         # still device_puts its own copy via shard_params below.
         params = preloaded_params if preloaded_params is not None else (
             weights.load_params(
-                model_cfg, checkpoint_path, seed=engine_cfg.seed, dtype=dtype
+                model_cfg, checkpoint_path, seed=engine_cfg.seed, dtype=dtype,
+                weights_dtype=engine_cfg.weights_dtype,
             )
         )
         tp_axis = mesh.shape.get("tensor", 1) if mesh is not None else 1
@@ -359,14 +375,18 @@ class ModelRuntime:
             self.cfg = model_cfg
             log.info("replicated KV heads x%d for tp=%d (%s)", r, tp_axis,
                      name)
-        kv_sharding = None
+        kv_sharding = scale_sharding = None
         if mesh is not None:
             from jax.sharding import NamedSharding
 
             params = shard_params(params, mesh, pp=self._pp > 1)
             kv_sharding = NamedSharding(mesh, kv_cache_spec(pp=self._pp > 1))
+            scale_sharding = NamedSharding(
+                mesh, kv_scale_spec(pp=self._pp > 1))
         self.params = params
-        self.kc, self.vc = kvc.alloc_kv_pool(model_cfg, engine_cfg, kv_sharding, dtype)
+        self.kc, self.vc = kvc.alloc_kv_pool(
+            model_cfg, engine_cfg, kv_sharding, dtype,
+            kv_dtype=engine_cfg.kv_dtype, scale_sharding=scale_sharding)
         # Repeat-penalty state: ring of each slot's last-W context token ids
         # (-1 = empty), llama.cpp repeat_last_n semantics. Row S is a trash
         # row so padded/inactive scatter targets never touch a live slot.
@@ -464,9 +484,10 @@ class ModelRuntime:
         # Ragged mixed-batch scheduling: prefill spans + decode tokens
         # pack into ONE token-budget dispatch (no bucket padding). The
         # pipeline-parallel forward is stage-scheduled and keeps the
-        # bucketed path; everything else defaults to ragged.
-        self.ragged = engine_cfg.attention_mode == "ragged" and self._pp == 1
-        if engine_cfg.attention_mode == "ragged" and self._pp > 1:
+        # bucketed prefill path (the --attention=bucketed oracle itself
+        # was removed one release after ragged shipped, as scheduled).
+        self.ragged = self._pp == 1
+        if self._pp > 1:
             log.warning("%s: pp=%d serves the bucketed prefill path "
                         "(the ragged forward is single-stage)", name,
                         self._pp)
@@ -557,9 +578,14 @@ class ModelRuntime:
         self.param_bytes = sum(
             x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(params)
         )
-        self.kv_bytes = kvc.kv_pool_bytes(
-            model_cfg, engine_cfg, jnp.dtype(dtype).itemsize
+        self.kv_bytes = sum(
+            x.size * x.dtype.itemsize
+            for x in jax.tree_util.tree_leaves((self.kc, self.vc))
         )
+        # HBM density scoreboard: what weights and KV actually cost on
+        # this runtime — the quantization PR's before/after lever.
+        tm.HBM_WEIGHT_BYTES.labels(model=name).set(self.param_bytes)
+        tm.HBM_KV_BYTES.labels(model=name).set(self.kv_bytes)
 
     # -- capacity ----------------------------------------------------------
     def free_slots(self) -> int:
@@ -617,12 +643,12 @@ class ModelRuntime:
 
     # -- compiled steps ----------------------------------------------------
     def _bucket_for(self, n: int) -> int:
-        """Smallest prefill bucket covering n tokens. Oversize pieces
-        must have been routed to the chunked/sequence-parallel path by
-        the caller — silently answering the largest bucket here would
-        truncate the forward's view of the prompt and mask a packing
-        bug (the bucketed path is the ragged path's diff-testing
-        oracle, so it must fail loudly, not approximately)."""
+        """Smallest prefill bucket covering n tokens (pp > 1 prefill/
+        chunk path). Oversize pieces must have been routed to the
+        chunked/sequence-parallel path by the caller — silently
+        answering the largest bucket here would truncate the forward's
+        view of the prompt and mask a packing bug, so it must fail
+        loudly, not approximately."""
         for b in self.ecfg.prefill_buckets:
             if n <= b:
                 return b
@@ -2789,6 +2815,8 @@ class ModelRuntime:
             "mfu": round(self.mfu, 4),
             "param_bytes": self.param_bytes,
             "kv_bytes": self.kv_bytes,
+            "weights_dtype": self.weights_dtype,
+            "kv_dtype": self.kv_dtype,
             # None = caching disabled (the TUI renders "cache n/a").
             "prefix_cache": (self.prefix_cache.stats()
                              if self.prefix_cache is not None else None),
@@ -2824,7 +2852,8 @@ class EncoderRuntime:
         self._failed = False
         self.tokenizer = load_tokenizer(checkpoint_path)
         params = weights.load_params(model_cfg, checkpoint_path,
-                                     seed=engine_cfg.seed, dtype=dtype)
+                                     seed=engine_cfg.seed, dtype=dtype,
+                                     weights_dtype=engine_cfg.weights_dtype)
         if mesh is not None:
             params = shard_params(params, mesh)
         self.params = params
@@ -2834,6 +2863,8 @@ class EncoderRuntime:
         self.param_bytes = sum(
             x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(params)
         )
+        tm.HBM_WEIGHT_BYTES.labels(model=name).set(self.param_bytes)
+        tm.HBM_KV_BYTES.labels(model=name).set(0)
         self.kv_bytes = 0
         self.tokens_generated = 0
         self.step_latency_ms = 0.0
@@ -2902,6 +2933,8 @@ class EncoderRuntime:
             "mfu": 0.0,  # encoders don't publish decode-step MFU
             "param_bytes": self.param_bytes,
             "kv_bytes": self.kv_bytes,
+            "weights_dtype": self.ecfg.weights_dtype,
+            "kv_dtype": "bfloat16",  # encoders hold no KV pool
             "prefix_cache": None,  # encoders hold no KV to share
             "spec": None,  # encoders decode nothing to speculate on
         }
@@ -2924,7 +2957,8 @@ def build_model_runtimes(name, cfg, engine_cfg, mesh, dtype, checkpoint_path,
                             checkpoint_path=checkpoint_path, dtype=dtype)]
     if engine_cfg.dp > 1 and mesh is not None:
         host_params = weights.load_params(
-            cfg, checkpoint_path, seed=engine_cfg.seed, dtype=dtype
+            cfg, checkpoint_path, seed=engine_cfg.seed, dtype=dtype,
+            weights_dtype=engine_cfg.weights_dtype,
         )
         reps = [
             model_cls(name, cfg, engine_cfg, mesh=replica_submesh(mesh, r),
@@ -3768,7 +3802,8 @@ class TPUEngine:
                             ran_ragged = True
                             did_work = True
                     else:
-                        # Bucketed oracle path (--attention=bucketed).
+                        # Pipeline-parallel path (pp > 1): stage-scheduled
+                        # bucketed prefill + fused decode.
                         # TTFT first: admit pending prefills into free
                         # slots — but bounded per tick, so a sustained
                         # arrival storm can't starve the active decode

@@ -243,6 +243,8 @@ class FakeRuntime:
             "param_bytes": self.param_bytes,
             "kv_bytes": self.kv_bytes,
             "prefix_cache": None,  # fake tokens carry no KV to share
+            "weights_dtype": "bfloat16",  # fake engine holds no weights
+            "kv_dtype": "bfloat16",  # ...and no KV pool
             "spec": None,  # fake drafts never roll back
         }
 

@@ -135,33 +135,63 @@ def alloc_kv_pool(
     engine_cfg: EngineConfig,
     sharding=None,
     dtype=jnp.bfloat16,
+    kv_dtype: str = "bfloat16",
+    scale_sharding=None,
 ):
-    """Allocate the device K/V slot pools (zeros). Returns (k_cache, v_cache)."""
-    shape = (
-        model_cfg.num_layers,
-        engine_cfg.num_pages * engine_cfg.page_size,
-        model_cfg.num_kv_heads,
-        model_cfg.head_dim,
-    )
-    if sharding is not None:
-        zeros = jax.jit(
-            lambda: jnp.zeros(shape, dtype), out_shardings=(sharding)
-        )
-        k = zeros()
-        v = zeros()
-    else:
-        k = jnp.zeros(shape, dtype)
-        v = jnp.zeros(shape, dtype)
+    """Allocate the device K/V slot pools (zeros). Returns (k_cache,
+    v_cache) — plain arrays, or QuantKV pairs when kv_dtype="int8": an
+    int8 payload pool plus fp32 per-slot per-head scale rows stored
+    page-aligned alongside it (slot = page * page_size + offset), so the
+    page allocator, prefix tree, preemption, and rollback machinery are
+    untouched while every page shrinks ~2x."""
+    from ollamamq_tpu.ops.quant import QuantKV
+
+    S = engine_cfg.num_pages * engine_cfg.page_size
+    shape = (model_cfg.num_layers, S, model_cfg.num_kv_heads,
+             model_cfg.head_dim)
+
+    def zeros(shp, dt, shard):
+        if shard is not None:
+            return jax.jit(lambda: jnp.zeros(shp, dt), out_shardings=shard)()
+        return jnp.zeros(shp, dt)
+
+    if kv_dtype == "int8":
+        sshape = shape[:-1]  # [L, S, Hk] scale rows
+        k = QuantKV(zeros(shape, jnp.int8, sharding),
+                    jnp.ones(sshape, jnp.float32) if scale_sharding is None
+                    else jax.jit(lambda: jnp.ones(sshape, jnp.float32),
+                                 out_shardings=scale_sharding)())
+        v = QuantKV(zeros(shape, jnp.int8, sharding),
+                    jnp.ones(sshape, jnp.float32) if scale_sharding is None
+                    else jax.jit(lambda: jnp.ones(sshape, jnp.float32),
+                                 out_shardings=scale_sharding)())
+        return k, v
+    k = zeros(shape, dtype, sharding)
+    v = zeros(shape, dtype, sharding)
     return k, v
 
 
-def kv_pool_bytes(model_cfg: ModelConfig, engine_cfg: EngineConfig, bytes_per_el=2) -> int:
+def kv_pool_bytes(model_cfg: ModelConfig, engine_cfg: EngineConfig,
+                  bytes_per_el=2, kv_dtype: str = "bfloat16") -> int:
+    """Planning-time pool size; int8 pools count 1 payload byte plus the
+    4-byte fp32 scale each (slot, head) row carries."""
+    per_tok_head = (model_cfg.head_dim + 4 if kv_dtype == "int8"
+                    else model_cfg.head_dim * bytes_per_el)
     return (
         2
         * model_cfg.num_layers
         * engine_cfg.num_pages
         * engine_cfg.page_size
         * model_cfg.num_kv_heads
-        * model_cfg.head_dim
-        * bytes_per_el
+        * per_tok_head
     )
+
+
+def kv_page_bytes(model_cfg: ModelConfig, page_size: int,
+                  bytes_per_el=2, kv_dtype: str = "bfloat16") -> int:
+    """Bytes ONE page costs (K and V, all layers) — the density math's
+    unit: equal-HBM pool sizing divides a byte budget by this."""
+    per_tok_head = (model_cfg.head_dim + 4 if kv_dtype == "int8"
+                    else model_cfg.head_dim * bytes_per_el)
+    return (2 * model_cfg.num_layers * page_size
+            * model_cfg.num_kv_heads * per_tok_head)

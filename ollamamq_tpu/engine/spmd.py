@@ -993,7 +993,19 @@ def run_worker(
     primary fails that runtime loudly and sends OP_RELOAD, which rebuilds
     the replica here from pristine config — no silently-diverged serving.
     """
-    from ollamamq_tpu.config import get_model_config
+    from ollamamq_tpu.config import get_model_config, validate_quant_config
+
+    # Same quantization fail-fast the primary's CLI runs: both sides
+    # build byte-identical computations, so a worker must reject an
+    # unsupported --weights-dtype/--kv-dtype combination at startup too
+    # (never mid-replay, where the primary would see a desync).
+    err = validate_quant_config(
+        engine_cfg.weights_dtype, engine_cfg.kv_dtype,
+        pp=dict(mesh.shape).get("pipe", 1),
+        sp=dict(mesh.shape).get("seq", 1),
+        model_names=list(models))
+    if err is not None:
+        raise ValueError(err)
 
     start_heartbeat()
     replica_lists = []  # [model ordinal] -> [replica ordinal] -> runtime|None

@@ -31,7 +31,15 @@ from ollamamq_tpu.ops.attention import (
     paged_decode_attention_any,
     ragged_attention_any,
 )
+from ollamamq_tpu.ops.quant import embed_lookup, kv_write, logits_head, qeinsum
 from ollamamq_tpu.ops.rope import apply_rope
+
+
+def _adtype(params: dict):
+    """Activation dtype for a forward: norm weights are never quantized,
+    so final_norm carries the compute dtype even when embed/matmul
+    weights are int8 QuantTensors."""
+    return params["final_norm"].dtype
 
 
 def rmsnorm(x: jnp.ndarray, w: jnp.ndarray, eps: float) -> jnp.ndarray:
@@ -88,9 +96,9 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
 def _qkv(cfg: ModelConfig, lp: dict, h: jnp.ndarray):
     """Project hidden -> q,k,v with head reshape. h: [B, T, D]."""
     B, T, _ = h.shape
-    q = jnp.einsum("btd,de->bte", h, lp["wq"])
-    k = jnp.einsum("btd,de->bte", h, lp["wk"])
-    v = jnp.einsum("btd,de->bte", h, lp["wv"])
+    q = qeinsum("btd,de->bte", h, lp["wq"])
+    k = qeinsum("btd,de->bte", h, lp["wk"])
+    v = qeinsum("btd,de->bte", h, lp["wv"])
     if cfg.attn_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -105,9 +113,9 @@ def _qkv(cfg: ModelConfig, lp: dict, h: jnp.ndarray):
 
 
 def _mlp(lp: dict, h: jnp.ndarray) -> jnp.ndarray:
-    gate = jnp.einsum("btd,df->btf", h, lp["w_gate"])
-    up = jnp.einsum("btd,df->btf", h, lp["w_up"])
-    return jnp.einsum("btf,fd->btd", jax.nn.silu(gate) * up, lp["w_down"])
+    gate = qeinsum("btd,df->btf", h, lp["w_gate"])
+    up = qeinsum("btd,df->btf", h, lp["w_up"])
+    return qeinsum("btf,fd->btd", jax.nn.silu(gate) * up, lp["w_down"])
 
 
 def _ffn(cfg: ModelConfig, lp: dict, h: jnp.ndarray,
@@ -127,7 +135,7 @@ def _ffn(cfg: ModelConfig, lp: dict, h: jnp.ndarray,
 def _logits(params: dict, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     x = rmsnorm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params.get("lm_head", params["embed"])
-    return jnp.einsum("btd,vd->btv", x.astype(jnp.float32), head.astype(jnp.float32))
+    return logits_head(x, head)
 
 
 def _layer_step(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
@@ -147,7 +155,7 @@ def _layer_step(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
     q = apply_rope(q, positions, cfg.rope_theta)
     k = apply_rope(k, positions, cfg.rope_theta)
     attn = attn_fn(q, k, v)
-    x = x + jnp.einsum("bte,ed->btd", attn.reshape(B, T, cfg.q_dim), lp["wo"])
+    x = x + qeinsum("bte,ed->btd", attn.reshape(B, T, cfg.q_dim), lp["wo"])
     h2 = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
     return x + _ffn(cfg, lp, h2, valid=valid), k, v
 
@@ -168,7 +176,7 @@ def forward_prefill(
     the write is fully static-shaped — no dynamic trimming needed.
     """
     B, T = tokens.shape
-    x = params["embed"][tokens].astype(params["embed"].dtype)
+    x = embed_lookup(params["embed"], tokens, _adtype(params))
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
     slots = flat_slot_indices(page_table, positions, page_size)  # [B, T]
 
@@ -180,8 +188,8 @@ def forward_prefill(
             lambda q, k, v: causal_attention(q, k, v, seq_lens),
             valid=positions < seq_lens[:, None],
         )
-        kc = kc.at[slots].set(k)
-        vc = vc.at[slots].set(v)
+        kc = kv_write(kc, slots, k)
+        vc = kv_write(vc, slots, v)
         return x, (kc, vc)
 
     x, (k_cache, v_cache) = jax.lax.scan(
@@ -211,7 +219,7 @@ def forward_prefill_chunk(
     the full paged context. Returns (last-valid-position logits, caches').
     """
     B, C = tokens.shape
-    x = params["embed"][tokens].astype(params["embed"].dtype)
+    x = embed_lookup(params["embed"], tokens, _adtype(params))
     positions = start[:, None] + jnp.broadcast_to(
         jnp.arange(C, dtype=jnp.int32), (B, C)
     )
@@ -223,8 +231,8 @@ def forward_prefill_chunk(
 
         def attn_fn(q, k, v):
             nonlocal kc, vc
-            kc = kc.at[slots].set(k)
-            vc = vc.at[slots].set(v)
+            kc = kv_write(kc, slots, k)
+            vc = kv_write(vc, slots, v)
             # Block-wise online-softmax walk over real pages only — HBM
             # reads scale with the actual prefix length, not max context.
             return paged_chunk_attention_blockwise(
@@ -278,7 +286,7 @@ def forward_ragged(
     (logits, caches').
     """
     T = tokens.shape[0]
-    x = params["embed"][tokens].astype(params["embed"].dtype)[None]  # [1,T,D]
+    x = embed_lookup(params["embed"], tokens, _adtype(params))[None]  # [1,T,D]
     positions = jnp.maximum(tok_pos, 0)[None, :]  # [1, T] RoPE positions
     valid = (tok_pos >= 0)[None, :]
 
@@ -288,8 +296,8 @@ def forward_ragged(
 
         def attn_fn(q, k, v):  # [1, T, H, hd]
             nonlocal kc, vc
-            kc = kc.at[write_slots].set(k[0])
-            vc = vc.at[write_slots].set(v[0])
+            kc = kv_write(kc, write_slots, k[0])
+            vc = kv_write(vc, write_slots, v[0])
             out = ragged_attention_any(
                 attn_impl, q[0], kc, vc, page_table, tok_seq, tok_pos,
                 kv_len, q_start, q_len, page_size, interpret=interpret,
@@ -330,7 +338,7 @@ def forward_decode(
     """
     B = tokens.shape[0]
     valid = None if active is None else (active > 0)[:, None]
-    x = params["embed"][tokens].astype(params["embed"].dtype)[:, None, :]  # [B,1,D]
+    x = embed_lookup(params["embed"], tokens, _adtype(params))[:, None, :]  # [B,1,D]
     pos2 = positions[:, None]  # [B,1]
     write_slots = flat_slot_indices(page_table, pos2, page_size)[:, 0]  # [B]
     seq_lens = positions + 1
@@ -342,12 +350,12 @@ def forward_decode(
         q, k, v = _qkv(cfg, lp, h)  # [B,1,H,hd]
         q = apply_rope(q, pos2, cfg.rope_theta)
         k = apply_rope(k, pos2, cfg.rope_theta)
-        kc = kc.at[write_slots].set(k[:, 0])
-        vc = vc.at[write_slots].set(v[:, 0])
+        kc = kv_write(kc, write_slots, k[:, 0])
+        vc = kv_write(vc, write_slots, v[:, 0])
         attn = paged_decode_attention_any(
             attn_impl, q[:, 0], kc, vc, page_table, seq_lens, page_size
         )  # [B,H,hd]
-        x = x + jnp.einsum("be,ed->bd", attn.reshape(B, cfg.q_dim), lp["wo"])[:, None, :]
+        x = x + qeinsum("be,ed->bd", attn.reshape(B, cfg.q_dim), lp["wo"])[:, None, :]
         h2 = rmsnorm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         x = x + _ffn(cfg, lp, h2, valid=valid)
         return x, (kc, vc)
@@ -380,7 +388,7 @@ def forward_prefill_sp(
 
     B, T = tokens.shape
     seq_sharded = NamedSharding(mesh, PS(None, AXIS_SEQ, None))
-    x = params["embed"][tokens].astype(params["embed"].dtype)
+    x = embed_lookup(params["embed"], tokens, _adtype(params))
     x = jax.lax.with_sharding_constraint(x, seq_sharded)
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
 
@@ -413,7 +421,7 @@ def forward_embed(
     backends run for /api/embed on e.g. llama3 (README.md /api/embed row).
     """
     B, T = tokens.shape
-    x = params["embed"][tokens].astype(params["embed"].dtype)
+    x = embed_lookup(params["embed"], tokens, _adtype(params))
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
 
     def body(carry, lp):
@@ -440,7 +448,7 @@ def forward_encoder(
 ) -> jnp.ndarray:
     """Embedding encoder: bidirectional attention + masked mean pool + L2 norm."""
     B, T = tokens.shape
-    x = params["embed"][tokens].astype(params["embed"].dtype)
+    x = embed_lookup(params["embed"], tokens, _adtype(params))
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
 
     def body(carry, lp):

@@ -21,6 +21,37 @@ import numpy as np
 
 from ollamamq_tpu.config import ModelConfig
 from ollamamq_tpu.models import llama
+from ollamamq_tpu.ops.quant import QuantTensor, quantize_tensor
+
+
+# Layer matmul weights quantized per-channel along their LAST axis (the
+# einsum output channel); embed/lm_head quantize per vocab ROW (axis 0 —
+# the logits einsum's output channel AND the embedding gather's row, so
+# one scale vector serves both uses of a tied embedding).
+QUANT_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+QUANT_ROW_KEYS = ("embed", "lm_head")
+
+
+def quantize_params_int8(params: dict, cfg: ModelConfig) -> dict:
+    """Per-channel symmetric int8 quantization of a loaded params tree
+    (scales fp32; norms, biases, and q/k norms stay in the load dtype).
+    Shapes are unchanged — each quantized leaf becomes a QuantTensor
+    pytree node, and the dequant-fused helpers in ops/quant.py keep
+    every forward's signature identical."""
+    if cfg.num_experts:
+        raise ValueError(
+            "int8 weight quantization does not cover MoE expert stacks; "
+            f"load {cfg.name} with --weights-dtype=bfloat16")
+    out = dict(params)
+    layers = dict(params["layers"])
+    for k in QUANT_LAYER_KEYS:
+        if k in layers:
+            layers[k] = quantize_tensor(layers[k], axis=-1)
+    out["layers"] = layers
+    for k in QUANT_ROW_KEYS:
+        if k in out:
+            out[k] = quantize_tensor(out[k], axis=0)
+    return out
 
 
 # HF tensor name -> (our tree path, transpose?) for one layer.
@@ -143,14 +174,23 @@ def load_params(
     checkpoint_path: Optional[str] = None,
     seed: int = 0,
     dtype=jnp.bfloat16,
+    weights_dtype: str = "bfloat16",
 ) -> dict:
-    """Resolve weights: checkpoint dir (safetensors/orbax) or random init."""
+    """Resolve weights: checkpoint dir (safetensors/orbax) or random init.
+    `weights_dtype="int8"` quantizes the loaded tree at load time
+    (per-channel symmetric, fp32 scales) — the checkpoint is still read
+    in `dtype` and the full-precision copy is dropped immediately."""
     if checkpoint_path:
         entries = os.listdir(checkpoint_path)
         if any(e.endswith(".safetensors") for e in entries):
-            return load_safetensors(cfg, checkpoint_path, dtype=dtype)
-        return load_orbax(checkpoint_path)
-    return init_random(cfg, seed=seed, dtype=dtype)
+            params = load_safetensors(cfg, checkpoint_path, dtype=dtype)
+        else:
+            params = load_orbax(checkpoint_path)
+    else:
+        params = init_random(cfg, seed=seed, dtype=dtype)
+    if weights_dtype == "int8":
+        params = quantize_params_int8(params, cfg)
+    return params
 
 
 def replicate_kv_heads(params: dict, cfg, r: int) -> dict:
@@ -165,6 +205,10 @@ def replicate_kv_heads(params: dict, cfg, r: int) -> dict:
     Hk, hd = cfg.num_kv_heads, cfg.head_dim
 
     def rep_w(w):  # [L, d, Hk*hd] -> [L, d, r*Hk*hd]
+        if isinstance(w, QuantTensor):
+            # Per-channel scales live on the duplicated axis: replicate
+            # payload and scales in lockstep, numerics exactly preserved.
+            return QuantTensor(rep_w(w.q), rep_b(w.s))
         L, d, _ = w.shape
         return jnp.repeat(
             w.reshape(L, d, Hk, hd), r, axis=2
@@ -182,4 +226,69 @@ def replicate_kv_heads(params: dict, cfg, r: int) -> dict:
         layers["bv"] = rep_b(layers["bv"])
     out = dict(params)
     out["layers"] = layers
+    return out
+
+
+def _full_logits(params: dict, cfg: ModelConfig, tokens) -> jnp.ndarray:
+    """Last-position logits of a full causal forward (no KV pool): the
+    minimal teacher-forced probe the quantization guardrail runs on both
+    the bf16 and int8 trees."""
+    from ollamamq_tpu.ops.attention import causal_attention
+
+    toks = jnp.asarray(tokens, jnp.int32)[None, :]  # [1, T]
+    B, T = toks.shape
+    seq_lens = jnp.full((B,), T, jnp.int32)
+    x = llama.embed_lookup(params["embed"], toks, llama._adtype(params))
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+
+    def body(carry, lp):
+        x, _, _ = llama._layer_step(
+            cfg, lp, carry, positions,
+            lambda q, k, v: causal_attention(q, k, v, seq_lens))
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return llama._logits(params, cfg, x[:, -1:, :])[0, 0]  # [V] f32
+
+
+def quant_guardrail(
+    cfg: ModelConfig,
+    base_params: Optional[dict] = None,
+    q_params: Optional[dict] = None,
+    seed: int = 0,
+    dtype=jnp.bfloat16,
+    prompt_len: int = 16,
+    steps: int = 16,
+) -> dict:
+    """Greedy token-match-rate + max-logit-error of the int8 tree vs its
+    bf16 source, teacher-forced on the bf16 model's own greedy rollout
+    (so one early mismatch can't cascade into a meaningless diff).
+    Publishes `ollamamq_quant_logit_err`; tier-1 pins the bounds and the
+    bench density scenario reports them next to its A/B line."""
+    from ollamamq_tpu.telemetry import schema as tm
+
+    if base_params is None:
+        base_params = init_random(cfg, seed=seed, dtype=dtype)
+    if q_params is None:
+        q_params = quantize_params_int8(base_params, cfg)
+    rng = np.random.default_rng(seed)
+    ctx = rng.integers(3, cfg.vocab_size, size=max(1, prompt_len)).tolist()
+    step = jax.jit(_full_logits, static_argnums=(1,))
+    matches, max_err = 0, 0.0
+    for _ in range(steps):
+        lb = np.asarray(step(base_params, cfg, ctx))
+        lq = np.asarray(step(q_params, cfg, ctx))
+        max_err = max(max_err, float(np.max(np.abs(lb - lq))))
+        tb, tq = int(np.argmax(lb)), int(np.argmax(lq))
+        matches += int(tb == tq)
+        ctx = ctx + [tb]  # teacher-forced: both follow the bf16 stream
+    out = {
+        "steps": steps,
+        "token_match_rate": round(matches / max(1, steps), 4),
+        "max_logit_err": round(max_err, 6),
+        # Scale-free companion: the same max error over the logit spread,
+        # so one bound serves both toy and real-shaped configs.
+        "rel_logit_err": round(max_err / max(1e-9, float(np.std(lb))), 6),
+    }
+    tm.QUANT_LOGIT_ERR.labels(model=cfg.name).set(out["max_logit_err"])
     return out

@@ -19,6 +19,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ollamamq_tpu.ops.quant import QuantKV, kv_gather
+
 NEG_INF = -1e30
 
 
@@ -100,8 +102,8 @@ def paged_chunk_attention(
     L = max_pages * page_size
     positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (B, L))
     slots = flat_slot_indices(page_table, positions, page_size)  # [B, L]
-    k = k_cache[slots]  # [B, L, Hk, hd]
-    v = v_cache[slots]
+    k = kv_gather(k_cache, slots)  # [B, L, Hk, hd] (int8 pools dequantize)
+    v = kv_gather(v_cache, slots)
     n_rep = H // k.shape[2]
     k = repeat_kv(k, n_rep)
     v = repeat_kv(v, n_rep)
@@ -162,8 +164,9 @@ def paged_chunk_attention_blockwise(
         pos = i * BLK + jnp.arange(BLK, dtype=jnp.int32)  # global positions
         slots = (pages[:, :, None] * page_size
                  + jnp.arange(page_size)[None, None, :]).reshape(B, BLK)
-        k = repeat_kv(k_cache[slots].astype(jnp.float32), n_rep)  # [B,BLK,H,hd]
-        v = repeat_kv(v_cache[slots].astype(jnp.float32), n_rep)
+        k = repeat_kv(kv_gather(k_cache, slots).astype(jnp.float32),
+                      n_rep)  # [B,BLK,H,hd]
+        v = repeat_kv(kv_gather(v_cache, slots).astype(jnp.float32), n_rep)
         logits = jnp.einsum("bchd,blhd->bhcl", qf, k)  # [B, H, C, BLK]
         causal = pos[None, None, None, :] <= q_pos[:, None, :, None]
         in_seq = pos[None, None, None, :] < end[:, None, None, None]
@@ -237,8 +240,8 @@ def ragged_paged_attention(
     rows = page_table[jnp.clip(tok_seq, 0, B - 1)]  # [T, max_pages]
     positions = jnp.broadcast_to(jnp.arange(L, dtype=jnp.int32), (T, L))
     slots = flat_slot_indices(rows, positions, page_size)  # [T, L]
-    k = k_cache[slots]  # [T, L, Hk, hd]
-    v = v_cache[slots]
+    k = kv_gather(k_cache, slots)  # [T, L, Hk, hd] (int8 pools dequantize)
+    v = kv_gather(v_cache, slots)
     n_rep = H // k.shape[2]
     k = repeat_kv(k, n_rep)
     v = repeat_kv(v, n_rep)
@@ -296,8 +299,9 @@ def ragged_paged_attention_blockwise(
         pos = i * BLK + jnp.arange(BLK, dtype=jnp.int32)
         slots = (pages[:, :, None] * page_size
                  + jnp.arange(page_size)[None, None, :]).reshape(T, BLK)
-        k = repeat_kv(k_cache[slots].astype(jnp.float32), n_rep)  # [T,BLK,H,hd]
-        v = repeat_kv(v_cache[slots].astype(jnp.float32), n_rep)
+        k = repeat_kv(kv_gather(k_cache, slots).astype(jnp.float32),
+                      n_rep)  # [T,BLK,H,hd]
+        v = repeat_kv(kv_gather(v_cache, slots).astype(jnp.float32), n_rep)
         logits = jnp.einsum("thd,tlhd->thl", qf, k)  # [T, H, BLK]
         keep = (pos[None, :] <= tok_pos[:, None]) \
             & (pos[None, :] < end[:, None])  # [T, BLK]
@@ -345,6 +349,14 @@ def ragged_attention_any(
             ragged_paged_attention_pallas,
         )
 
+        if isinstance(k_cache, QuantKV):
+            # Quantized pool: int8 payloads DMA as usual, the per-slot
+            # scale rows ride along and dequantize in-kernel.
+            return ragged_paged_attention_pallas(
+                q, k_cache.q, v_cache.q, page_table, q_start, q_lens,
+                kv_lens, page_size, interpret=interpret,
+                k_scale=k_cache.s, v_scale=v_cache.s,
+            )
         return ragged_paged_attention_pallas(
             q, k_cache, v_cache, page_table, q_start, q_lens, kv_lens,
             page_size, interpret=interpret,
@@ -373,6 +385,12 @@ def paged_decode_attention_any(
             paged_decode_attention_pallas,
         )
 
+        if isinstance(k_cache, QuantKV):
+            return paged_decode_attention_pallas(
+                q, k_cache.q, v_cache.q, page_table, seq_lens, page_size,
+                interpret=interpret,
+                k_scale=k_cache.s, v_scale=v_cache.s,
+            )
         return paged_decode_attention_pallas(
             q, k_cache, v_cache, page_table, seq_lens, page_size,
             interpret=interpret,

@@ -48,27 +48,24 @@ def _decode_kernel(
     # scalar prefetch
     page_table_ref,  # [B, max_pages] SMEM
     seq_lens_ref,  # [B] SMEM
-    # inputs
-    q_ref,  # [1, group, Hk*hd] VMEM (this program's query, packed)
-    k_hbm,  # [S, Hk*hd] HBM
-    v_hbm,  # [S, Hk*hd] HBM
-    # output
-    o_ref,  # [1, group, Hk*hd] VMEM (packed like q)
-    # scratch
-    k_buf,  # [R, page_size, Hk*hd] VMEM ring
-    v_buf,  # [R, page_size, Hk*hd] VMEM ring
-    acc,  # [group, Hk*hd] f32 VMEM
-    m_i,  # [group, Hk] f32 VMEM running max
-    l_i,  # [group, Hk] f32 VMEM running denom
-    sems,  # [R, 2] DMA semaphores (buffer, k/v)
-    *,
+    # inputs + output + scratch (quantized pools append scale planes —
+    # see the unpack below; layouts match the unquantized kernel)
+    *refs,
     page_size: int,
     max_pages: int,
     num_heads: int,
     num_kv_heads: int,
     head_dim: int,
     ring: int,
+    quantized: bool,
 ):
+    if quantized:
+        (q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm, o_ref,
+         k_buf, v_buf, ks_buf, vs_buf, acc, m_i, l_i, sems) = refs
+    else:
+        (q_ref, k_hbm, v_hbm, o_ref,
+         k_buf, v_buf, acc, m_i, l_i, sems) = refs
+        ks_hbm = vs_hbm = ks_buf = vs_buf = None
     b = pl.program_id(0)
     nb = pl.num_programs(0)
     seq_len = seq_lens_ref[b]
@@ -88,18 +85,26 @@ def _decode_kernel(
     def page_dma(slot, row, page_idx):
         page_id = page_table_ref[row, page_idx]
         start = page_id * page_size
-        k_dma = pltpu.make_async_copy(
-            k_hbm.at[pl.ds(start, page_size)], k_buf.at[slot], sems.at[slot, 0]
-        )
-        v_dma = pltpu.make_async_copy(
-            v_hbm.at[pl.ds(start, page_size)], v_buf.at[slot], sems.at[slot, 1]
-        )
-        return k_dma, v_dma
+        copies = [
+            pltpu.make_async_copy(
+                k_hbm.at[pl.ds(start, page_size)], k_buf.at[slot],
+                sems.at[slot, 0]),
+            pltpu.make_async_copy(
+                v_hbm.at[pl.ds(start, page_size)], v_buf.at[slot],
+                sems.at[slot, 1]),
+        ]
+        if quantized:
+            copies.append(pltpu.make_async_copy(
+                ks_hbm.at[pl.ds(start, page_size)], ks_buf.at[slot],
+                sems.at[slot, 2]))
+            copies.append(pltpu.make_async_copy(
+                vs_hbm.at[pl.ds(start, page_size)], vs_buf.at[slot],
+                sems.at[slot, 3]))
+        return copies
 
     def start_page(slot, row, page_idx):
-        k_dma, v_dma = page_dma(slot, row, page_idx)
-        k_dma.start()
-        v_dma.start()
+        for dma in page_dma(slot, row, page_idx):
+            dma.start()
 
     # Fill the ring — but ONLY for the first grid program: every later
     # program's first `ring` pages were started by its predecessor's
@@ -133,12 +138,22 @@ def _decode_kernel(
     def body(p, _):
         slot = p % ring
 
-        kp, vp = page_dma(slot, b, p)
-        kp.wait()
-        vp.wait()
+        for dma in page_dma(slot, b, p):
+            dma.wait()
 
         k = k_buf[slot].astype(jnp.float32)  # [ps, lanes]
         v = v_buf[slot].astype(jnp.float32)
+        if quantized:
+            # In-kernel dequant: per-head scale rows expand to lane
+            # segments via the seg_t MXU trick (no relayouts).
+            k = k * jax.lax.dot_general(
+                ks_buf[slot], seg_t,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            v = v * jax.lax.dot_general(
+                vs_buf[slot], seg_t,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
 
         # Ring slot consumed (values loaded above): refill it with the
         # page `ring` ahead, keeping ring-1 copies in flight.
@@ -213,13 +228,16 @@ def _decode_kernel(
 @functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
 def paged_decode_attention_pallas(
     q: jnp.ndarray,  # [B, H, hd]
-    k_cache: jnp.ndarray,  # [S, Hk, hd]
+    k_cache: jnp.ndarray,  # [S, Hk, hd] (int8 when k_scale is passed)
     v_cache: jnp.ndarray,  # [S, Hk, hd]
     page_table: jnp.ndarray,  # [B, max_pages]
     seq_lens: jnp.ndarray,  # [B]
     page_size: int,
     interpret: bool = False,
+    k_scale=None,  # [S, Hk] f32 per-slot per-head scales (int8 pools)
+    v_scale=None,
 ) -> jnp.ndarray:
+    quantized = k_scale is not None
     B, H, hd = q.shape
     _, Hk, _ = k_cache.shape
     max_pages = page_table.shape[1]
@@ -237,27 +255,41 @@ def paged_decode_attention_pallas(
         num_kv_heads=Hk,
         head_dim=hd,
         ring=ring,
+        quantized=quantized,
     )
 
+    in_specs = [
+        pl.BlockSpec((1, group, lanes), lambda b, *_: (b, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pl.ANY),  # k stays in HBM
+        pl.BlockSpec(memory_space=pl.ANY),  # v stays in HBM
+    ]
+    scratch = [
+        pltpu.VMEM((ring, page_size, lanes), k_cache.dtype),
+        pltpu.VMEM((ring, page_size, lanes), v_cache.dtype),
+    ]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec(memory_space=pl.ANY),  # k scale rows (HBM)
+            pl.BlockSpec(memory_space=pl.ANY),  # v scale rows (HBM)
+        ]
+        scratch += [
+            pltpu.VMEM((ring, page_size, Hk), jnp.float32),
+            pltpu.VMEM((ring, page_size, Hk), jnp.float32),
+        ]
+    scratch += [
+        pltpu.VMEM((group, lanes), jnp.float32),
+        pltpu.VMEM((group, Hk), jnp.float32),
+        pltpu.VMEM((group, Hk), jnp.float32),
+        pltpu.SemaphoreType.DMA((ring, 4 if quantized else 2)),
+    ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B,),
-        in_specs=[
-            pl.BlockSpec((1, group, lanes), lambda b, *_: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pl.ANY),  # k stays in HBM
-            pl.BlockSpec(memory_space=pl.ANY),  # v stays in HBM
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, group, lanes), lambda b, *_: (b, 0, 0),
                                memory_space=pltpu.VMEM),
-        scratch_shapes=[
-            pltpu.VMEM((ring, page_size, lanes), k_cache.dtype),
-            pltpu.VMEM((ring, page_size, lanes), v_cache.dtype),
-            pltpu.VMEM((group, lanes), jnp.float32),
-            pltpu.VMEM((group, Hk), jnp.float32),
-            pltpu.VMEM((group, Hk), jnp.float32),
-            pltpu.SemaphoreType.DMA((ring, 2)),
-        ],
+        scratch_shapes=scratch,
     )
 
     # Pack q head-group-major so each kernel row g holds every kv head's
@@ -268,13 +300,18 @@ def paged_decode_attention_pallas(
     q_packed = (
         q.reshape(B, Hk, group, hd).transpose(0, 2, 1, 3).reshape(B, group, lanes)
     )
+    operands = [q_packed, k_cache.reshape(-1, lanes),
+                v_cache.reshape(-1, lanes)]
+    if quantized:
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, group, lanes), q.dtype),
         interpret=interpret,
     )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32),
-      q_packed, k_cache.reshape(-1, lanes), v_cache.reshape(-1, lanes))
+      *operands)
     return (
         out.reshape(B, group, Hk, hd).transpose(0, 2, 1, 3).reshape(B, H, hd)
     )

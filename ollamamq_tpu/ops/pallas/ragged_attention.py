@@ -31,7 +31,14 @@ Mosaic layout constraints follow the proven decode kernel: K/V move as
 flattened [page_size, Hk*hd] rows, q arrives packed [T, group, Hk*hd]
 (query-group-major, kv-segment lanes), and per-head segmentation uses
 constant 0/1 segment matrices on the MXU so no in-kernel relayouts are
-needed. Cross-tile DMA prefetch (the decode kernel's cross-program
+needed.
+
+Int8 KV pages (`k_scale`/`v_scale` passed): the payload DMAs exactly as
+bf16 pages do (half the bytes), each page's fp32 [page_size, Hk] scale
+row rides a third/fourth DMA into its own VMEM buffer, and dequant
+happens in-kernel right after the wait — scale rows expand to lane
+segments with the same seg_t matmul the softmax bookkeeping uses, so the
+int8 path adds no relayouts. Softmax/accumulation stay f32 as before. Cross-tile DMA prefetch (the decode kernel's cross-program
 epilogue) is intentionally absent for now: sequence boundaries inside a
 tile make the hand-off non-trivial, and the page loop already overlaps
 DMA with compute within a sequence.
@@ -61,20 +68,8 @@ def _ragged_kernel(
     q_len_ref,  # [B] SMEM: span length (0 = padding row)
     kv_len_ref,  # [B] SMEM: context length incl. the span's tokens
     page_table_ref,  # [B, max_pages] SMEM
-    # inputs
-    q_ref,  # [G_TILE, group, Hk*hd] VMEM (this tile's queries, packed)
-    k_hbm,  # [S, Hk*hd] HBM
-    v_hbm,  # [S, Hk*hd] HBM
-    # output
-    o_ref,  # [G_TILE, group, Hk*hd] VMEM (packed like q)
-    # scratch
-    k_buf,  # [R, page_size, Hk*hd] VMEM ring
-    v_buf,  # [R, page_size, Hk*hd] VMEM ring
-    acc,  # [G_TILE*group, Hk*hd] f32 VMEM
-    m_i,  # [G_TILE*group, Hk] f32 VMEM running max
-    l_i,  # [G_TILE*group, Hk] f32 VMEM running denom
-    sems,  # [R, 2] DMA semaphores
-    *,
+    # inputs (quantized pools append ks_hbm/vs_hbm scale planes)
+    *refs,
     page_size: int,
     max_pages: int,
     num_heads: int,
@@ -82,7 +77,15 @@ def _ragged_kernel(
     head_dim: int,
     ring: int,
     num_seqs: int,
+    quantized: bool,
 ):
+    if quantized:
+        (q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm, o_ref,
+         k_buf, v_buf, ks_buf, vs_buf, acc, m_i, l_i, sems) = refs
+    else:
+        (q_ref, k_hbm, v_hbm, o_ref,
+         k_buf, v_buf, acc, m_i, l_i, sems) = refs
+        ks_hbm = vs_hbm = ks_buf = vs_buf = None
     t = pl.program_id(0)
     tile_start = t * G_TILE
     group = num_heads // num_kv_heads
@@ -92,18 +95,28 @@ def _ragged_kernel(
     def page_dma(slot, row, page_idx):
         page_id = page_table_ref[row, page_idx]
         start = page_id * page_size
-        k_dma = pltpu.make_async_copy(
-            k_hbm.at[pl.ds(start, page_size)], k_buf.at[slot], sems.at[slot, 0]
-        )
-        v_dma = pltpu.make_async_copy(
-            v_hbm.at[pl.ds(start, page_size)], v_buf.at[slot], sems.at[slot, 1]
-        )
-        return k_dma, v_dma
+        copies = [
+            pltpu.make_async_copy(
+                k_hbm.at[pl.ds(start, page_size)], k_buf.at[slot],
+                sems.at[slot, 0]),
+            pltpu.make_async_copy(
+                v_hbm.at[pl.ds(start, page_size)], v_buf.at[slot],
+                sems.at[slot, 1]),
+        ]
+        if quantized:
+            # Scale rows travel with their page: same slot indexing, a
+            # [page_size, Hk] f32 plane per page.
+            copies.append(pltpu.make_async_copy(
+                ks_hbm.at[pl.ds(start, page_size)], ks_buf.at[slot],
+                sems.at[slot, 2]))
+            copies.append(pltpu.make_async_copy(
+                vs_hbm.at[pl.ds(start, page_size)], vs_buf.at[slot],
+                sems.at[slot, 3]))
+        return copies
 
     def start_page(slot, row, page_idx):
-        k_dma, v_dma = page_dma(slot, row, page_idx)
-        k_dma.start()
-        v_dma.start()
+        for dma in page_dma(slot, row, page_idx):
+            dma.start()
 
     acc[...] = jnp.zeros_like(acc)
     m_i[...] = jnp.full_like(m_i, NEG_INF)
@@ -155,11 +168,22 @@ def _ragged_kernel(
 
             def body(p, _):
                 slot = p % ring
-                kp, vp = page_dma(slot, s, p)
-                kp.wait()
-                vp.wait()
+                for dma in page_dma(slot, s, p):
+                    dma.wait()
                 k = k_buf[slot].astype(jnp.float32)  # [ps, lanes]
                 v = v_buf[slot].astype(jnp.float32)
+                if quantized:
+                    # Dequantize in-kernel: per-head scale rows expand to
+                    # lane segments via the same seg_t MXU trick the
+                    # softmax bookkeeping uses (no relayouts).
+                    k = k * jax.lax.dot_general(
+                        ks_buf[slot], seg_t,
+                        dimension_numbers=(((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                    v = v * jax.lax.dot_general(
+                        vs_buf[slot], seg_t,
+                        dimension_numbers=(((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32)
 
                 @pl.when(p + ring < npages)
                 def _():
@@ -234,7 +258,7 @@ def _ragged_kernel(
 @functools.partial(jax.jit, static_argnames=("page_size", "interpret"))
 def ragged_paged_attention_pallas(
     q: jnp.ndarray,  # [T, H, hd] flattened mixed-batch queries
-    k_cache: jnp.ndarray,  # [S, Hk, hd]
+    k_cache: jnp.ndarray,  # [S, Hk, hd] (int8 when k_scale is passed)
     v_cache: jnp.ndarray,  # [S, Hk, hd]
     page_table: jnp.ndarray,  # [B, max_pages]
     q_start: jnp.ndarray,  # [B] span offset per sequence (T for padding)
@@ -242,7 +266,10 @@ def ragged_paged_attention_pallas(
     kv_lens: jnp.ndarray,  # [B] context length incl. the span
     page_size: int,
     interpret: bool = False,
+    k_scale=None,  # [S, Hk] f32 per-slot per-head scales (int8 pools)
+    v_scale=None,
 ) -> jnp.ndarray:
+    quantized = k_scale is not None
     T, H, hd = q.shape
     B, max_pages = page_table.shape
     Hk = k_cache.shape[1]
@@ -268,28 +295,42 @@ def ragged_paged_attention_pallas(
         head_dim=hd,
         ring=ring,
         num_seqs=B,
+        quantized=quantized,
     )
 
+    in_specs = [
+        pl.BlockSpec((G_TILE, group, lanes), lambda t, *_: (t, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pl.ANY),  # k stays in HBM
+        pl.BlockSpec(memory_space=pl.ANY),  # v stays in HBM
+    ]
+    scratch = [
+        pltpu.VMEM((ring, page_size, lanes), k_cache.dtype),
+        pltpu.VMEM((ring, page_size, lanes), v_cache.dtype),
+    ]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec(memory_space=pl.ANY),  # k scale rows (HBM)
+            pl.BlockSpec(memory_space=pl.ANY),  # v scale rows (HBM)
+        ]
+        scratch += [
+            pltpu.VMEM((ring, page_size, Hk), jnp.float32),
+            pltpu.VMEM((ring, page_size, Hk), jnp.float32),
+        ]
+    scratch += [
+        pltpu.VMEM((G_TILE * group, lanes), jnp.float32),
+        pltpu.VMEM((G_TILE * group, Hk), jnp.float32),
+        pltpu.VMEM((G_TILE * group, Hk), jnp.float32),
+        pltpu.SemaphoreType.DMA((ring, 4 if quantized else 2)),
+    ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(n_tiles,),
-        in_specs=[
-            pl.BlockSpec((G_TILE, group, lanes), lambda t, *_: (t, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pl.ANY),  # k stays in HBM
-            pl.BlockSpec(memory_space=pl.ANY),  # v stays in HBM
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((G_TILE, group, lanes),
                                lambda t, *_: (t, 0, 0),
                                memory_space=pltpu.VMEM),
-        scratch_shapes=[
-            pltpu.VMEM((ring, page_size, lanes), k_cache.dtype),
-            pltpu.VMEM((ring, page_size, lanes), v_cache.dtype),
-            pltpu.VMEM((G_TILE * group, lanes), jnp.float32),
-            pltpu.VMEM((G_TILE * group, Hk), jnp.float32),
-            pltpu.VMEM((G_TILE * group, Hk), jnp.float32),
-            pltpu.SemaphoreType.DMA((ring, 2)),
-        ],
+        scratch_shapes=scratch,
     )
 
     # Pack q head-group-major (see the decode kernel): row r holds every
@@ -299,6 +340,11 @@ def ragged_paged_attention_pallas(
     )
     if Tp != T:
         q_packed = jnp.pad(q_packed, ((0, Tp - T), (0, 0), (0, 0)))
+    operands = [q_packed, k_cache.reshape(-1, lanes),
+                v_cache.reshape(-1, lanes)]
+    if quantized:
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -306,7 +352,7 @@ def ragged_paged_attention_pallas(
         interpret=interpret,
     )(tile_first, q_start.astype(jnp.int32), q_lens.astype(jnp.int32),
       kv_lens.astype(jnp.int32), page_table.astype(jnp.int32),
-      q_packed, k_cache.reshape(-1, lanes), v_cache.reshape(-1, lanes))
+      *operands)
     return (
         out[:T].reshape(T, group, Hk, hd).transpose(0, 2, 1, 3).reshape(T, H, hd)
     )

@@ -23,6 +23,7 @@ from typing import Any, Dict
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PS
 
+from ollamamq_tpu.ops.quant import QuantTensor
 from ollamamq_tpu.parallel.mesh import AXIS_EXPERT, AXIS_PIPE, AXIS_TENSOR
 
 
@@ -31,6 +32,22 @@ def param_partition_specs(params: Dict[str, Any]) -> Dict[str, Any]:
     PartitionSpecs by leaf path name."""
 
     def spec_for(path: str, leaf) -> PS:
+        if isinstance(leaf, QuantTensor):
+            # Quantized leaf: payload takes the bf16 tensor's spec; the
+            # per-channel scale vector shards with the channel when the
+            # payload's SHARDED axis is the channel axis (column-parallel
+            # weights, vocab-sharded embed/lm_head) and replicates when
+            # the sharded axis is the contraction (row-parallel wo /
+            # w_down — their channel dim is unsharded).
+            name = path.split("/")[-1]
+            qspec = spec_for(path, leaf.q)
+            if name in ("wq", "wk", "wv", "w_gate", "w_up"):
+                sspec = PS(*([None] * (leaf.s.ndim - 1)), AXIS_TENSOR)
+            elif name in ("embed", "lm_head"):
+                sspec = PS(AXIS_TENSOR)  # per-row scales follow the rows
+            else:
+                sspec = PS()
+            return QuantTensor(qspec, sspec)
         name = path.split("/")[-1]
         nd = leaf.ndim
         # Layer weights are stacked on a leading num_layers axis (scan over
@@ -75,6 +92,13 @@ def kv_cache_spec(pp: bool = False) -> PS:
     """KV slot pool [L, slots, kv_heads, head_dim]: heads on tensor axis;
     under pipeline parallelism layers also split over the pipe axis."""
     return PS(AXIS_PIPE if pp else None, None, AXIS_TENSOR, None)
+
+
+def kv_scale_spec(pp: bool = False) -> PS:
+    """Quantized-pool scale rows [L, slots, kv_heads]: same layout as
+    the payload minus the head_dim axis, so each tensor shard owns its
+    own heads' scales."""
+    return PS(AXIS_PIPE if pp else None, None, AXIS_TENSOR)
 
 
 def shard_params(params, mesh: Mesh, pp: bool = False):

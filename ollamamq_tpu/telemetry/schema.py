@@ -188,6 +188,24 @@ JOURNAL_EVENTS_TOTAL = REGISTRY.counter(
     "flight recorder's write rate; tail the ring at /debug/journal)",
     labels=("kind",))
 
+# -- int8 quantization (serving density; --weights-dtype / --kv-dtype) -----
+HBM_WEIGHT_BYTES = REGISTRY.gauge(
+    "ollamamq_hbm_weight_bytes",
+    "Bytes the loaded weights occupy per model runtime (int8 payloads + "
+    "fp32 scales when --weights-dtype=int8 — the density lever's "
+    "before/after)", labels=("model",))
+HBM_KV_BYTES = REGISTRY.gauge(
+    "ollamamq_hbm_kv_bytes",
+    "Bytes the KV page pool occupies per model runtime (int8 pages + "
+    "fp32 scale rows when --kv-dtype=int8; ~2x more concurrent requests "
+    "fit the same budget)", labels=("model",))
+QUANT_LOGIT_ERR = REGISTRY.gauge(
+    "ollamamq_quant_logit_err",
+    "Max absolute logit error of the int8-quantized weights vs their "
+    "bf16 source on the guardrail probe (teacher-forced greedy rollout; "
+    "set when the guardrail runs — tests, bench density scenario)",
+    labels=("model",))
+
 # -- host / device ---------------------------------------------------------
 HBM_USED_BYTES = REGISTRY.gauge(
     "ollamamq_hbm_used_bytes",

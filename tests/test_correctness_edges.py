@@ -162,19 +162,21 @@ def test_prefill_drain_bounded_per_tick():
     """An arrival storm must not starve decode: _loop_once admits at most
     prefill_batches_per_tick batched prefills before dispatching decode
     (VERDICT r3 weak #5)."""
-    # attention_mode pinned: the per-tick batched-prefill budget belongs
-    # to the bucketed oracle path (the ragged path admits into spans and
-    # dispatches exactly once per tick by construction).
+    # The per-tick batched-prefill budget belongs to the pipeline-path
+    # loop branch (pp > 1 runtimes; the ragged path admits into spans and
+    # dispatches exactly once per tick by construction). The bucketed
+    # oracle flag is gone, so force the runtime onto that branch the way
+    # a pp runtime lands there: ragged=False.
     eng = TPUEngine(
         EngineConfig(model="test-tiny", max_slots=2, num_pages=32,
                      page_size=8, max_pages_per_seq=8,
                      prefill_buckets=(16,), decode_steps_per_iter=2,
-                     prefill_batches_per_tick=2,
-                     attention_mode="bucketed"),
+                     prefill_batches_per_tick=2),
         models={"test-tiny": None},
         blocklist_path=None, dtype=jnp.float32,
     )
     rt = eng.runtimes["test-tiny"]
+    rt.ragged = False  # drive the pipeline-path loop branch
     calls = []
     rt.step_prefill = lambda core: (calls.append(1), True)[1]
     # A real queued request (sweep_blocked walks held requests); the stub

@@ -48,7 +48,7 @@ def make_rt(spec, copy_weights=False, **kw):
     defaults = dict(
         model="test-tiny", max_slots=4, num_pages=256, page_size=PS,
         max_pages_per_seq=32, prefill_buckets=(16, 64), max_new_tokens=96,
-        decode_steps_per_iter=2, attention_mode="ragged",
+        decode_steps_per_iter=2,
         max_batch_tokens=64, token_granule=8, spec=spec, spec_k=4,
         spec_min_accept=0.0,
     )
