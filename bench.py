@@ -243,6 +243,18 @@ def main() -> int:
     p.add_argument("--overload-queue-cap", type=int, default=0,
                    help="queued-request cap for the overload scenario "
                         "(0 = 2x slots)")
+    p.add_argument("--fleet", type=int, default=240,
+                   help="requests in the fleet scenario (kill-and-drain "
+                        "chaos through the dispatcher-over-engines "
+                        "router at ~10x the overload scenario's count; "
+                        "0 disables). Runs on tiny members so the chaos "
+                        "is cheap — the readout is robustness counters "
+                        "(dropped_streams, failovers, affinity hits, "
+                        "byte-identical resumed streams), not tok/s")
+    p.add_argument("--fleet-replicas", type=int, default=2,
+                   help="engine replicas behind the router in the fleet "
+                        "scenario's chaos leg (the golden leg always "
+                        "runs one)")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU platform (smoke-testing the harness)")
     p.add_argument("--init-timeout", type=float, default=300.0,
@@ -715,6 +727,21 @@ def main() -> int:
             print(f"# slo_burst scenario failed: {slo_burst['error']}",
                   file=sys.stderr)
 
+    # fleet scenario: kill-and-drain chaos through the fleet router at
+    # ~10x the overload request count — a seeded replica-kill fault plan
+    # plus a mid-run POST /admin/drain, with the zero-drop contract
+    # checked in-band: dropped_streams == 0, silent_truncations == 0,
+    # journal invariants clean, and every failed-over stream
+    # byte-identical to the unkilled golden run.
+    fleet = None
+    if args.fleet > 0:
+        try:
+            fleet = _fleet_scenario(args, rng, touch)
+        except Exception as e:  # never discard the decode numbers
+            fleet = {"error": f"{type(e).__name__}: {e}"}
+            print(f"# fleet scenario failed: {fleet['error']}",
+                  file=sys.stderr)
+
     result = {
         "metric": "decode_tok_per_s_per_chip",
         "value": round(tok_per_s, 1),
@@ -772,6 +799,8 @@ def main() -> int:
         result["overload"] = overload
     if density is not None:
         result["density"] = density
+    if fleet is not None:
+        result["fleet"] = fleet
     run_done.set()
     print(json.dumps(result), flush=True)
     return 0
@@ -785,6 +814,183 @@ def _pump(rt, core, touch, phase):
     progressed = rt.step_ragged(core)
     touch(phase)
     return progressed
+
+
+def _fleet_scenario(args, rng, touch):
+    """Fleet robustness acceptance: the SAME seeded arrival trace runs
+    (a) through a single-replica fleet untouched (the golden leg) and
+    (b) through an N-replica fleet under kill-and-drain chaos — a seeded
+    `replica` fault plan crashes a member mid-serving and a mid-run
+    drain_replica exercises the zero-drop rolling-restart path. The
+    contract checked in-band: dropped_streams == 0, silent_truncations
+    == 0, journal invariants (incl. no-dropped-streams) clean, and every
+    stream — failed-over ones included — byte-identical to the golden
+    leg. Members are tiny real engines (test-tiny, prefix cache on so
+    affinity placement has a radix signal); the readout is robustness
+    counters, not throughput."""
+    import dataclasses
+    import time
+
+    import jax.numpy as jnp
+
+    from ollamamq_tpu.config import EngineConfig
+    from ollamamq_tpu.engine.engine import TPUEngine
+    from ollamamq_tpu.fleet import FleetRouter, LocalMember
+    from ollamamq_tpu.ops.sampling import SamplingParams
+    from ollamamq_tpu.telemetry import schema as tm
+    from ollamamq_tpu.telemetry.journal import check_invariants
+    from ollamamq_tpu.testing.faults import FaultPlan
+    from ollamamq_tpu.tools.journal import check_no_dropped_streams
+
+    n_total = args.fleet
+    n_members = max(2, args.fleet_replicas)
+    max_new = 8
+    member_kw = dict(model="test-tiny", max_slots=8, num_pages=128,
+                     page_size=8, max_pages_per_seq=8,
+                     decode_steps_per_iter=2, prefill_buckets=(32, 64),
+                     prefix_cache=True)
+    # Per-user shared prompt prefixes: repeat traffic from the same user
+    # hits that user's cached prefix, giving --placement=affinity a
+    # radix-tree signal to route on.
+    n_users = 8
+    prefixes = [rng.integers(3, 500, size=17).tolist()
+                for _ in range(n_users)]
+    arrivals = [(f"fl{i % n_users}",
+                 prefixes[i % n_users]
+                 + rng.integers(3, 500, size=6).tolist())
+                for i in range(n_total)]
+
+    def run_leg(replicas, plan, drain):
+        ecfg = EngineConfig(fault_plan=plan, **member_kw)
+        member_cfg = dataclasses.replace(ecfg, fault_plan=None)
+        members = [
+            LocalMember(f"r{i}", TPUEngine(
+                member_cfg, models={"test-tiny": None},
+                blocklist_path=None, dtype=jnp.float32))
+            for i in range(replicas)
+        ]
+        # Heartbeat threshold generous enough that a multi-second jit
+        # compile inside one engine iteration doesn't read as a hung
+        # loop; the injected kill is detected via thread death, not
+        # staleness, so it still ejects immediately.
+        router = FleetRouter(
+            members, ecfg, blocklist_path=None, probe_period_s=0.1,
+            eject_heartbeat_s=5.0, reprobe_backoff_s=0.2,
+            evac_grace_s=1.0, drain_timeout_s=8.0)
+        router.start()
+        reqs, rids, items = [], [], []
+        issued, drained = 0, not drain
+        t0 = time.monotonic()
+        deadline = t0 + 600.0
+        try:
+            while True:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        "fleet leg wedged: "
+                        f"{sum(1 for r in reqs if not r.stats.finished_at)}"
+                        " unresolved")
+                done = sum(1 for r in reqs if r.stats.finished_at)
+                # Bounded in-flight issuance: the trace stretches across
+                # the whole serving window, so the chaos lands mid-stream.
+                while issued < n_total and issued - done < 4 * member_kw[
+                        "max_slots"]:
+                    user, prompt = arrivals[issued]
+                    req = router.enqueue_request(
+                        user, "", "test-tiny", prompt_tokens=prompt,
+                        sampling=SamplingParams(max_tokens=max_new))
+                    reqs.append(req)
+                    rids.append(req.req_id)  # rid0: stable journal id
+                    items.append([])
+                    issued += 1
+                for i, r in enumerate(reqs):
+                    items[i].extend(r.stream.drain())
+                if not drained and done >= n_total // 3 \
+                        and issued > n_total // 2:
+                    router.drain_replica("r0")
+                    drained = True
+                touch("fleet")
+                if issued >= n_total and done >= n_total:
+                    for i, r in enumerate(reqs):
+                        items[i].extend(r.stream.drain())
+                    break
+                time.sleep(0.01)
+            jrecs = router.journal.tail(None)
+            return {
+                "texts": ["".join(it.text for it in seq
+                                  if it.kind == "token") for seq in items],
+                "terminals": [next((it for it in reversed(seq)
+                                    if it.kind in ("done", "error")), None)
+                              for seq in items],
+                "rids": rids,
+                "journal": jrecs,
+                "failovers": router.failover_count,
+                "elapsed_s": round(time.monotonic() - t0, 3),
+            }
+        finally:
+            router.stop()
+
+    golden = run_leg(1, None, drain=False)
+    affinity0 = tm.FLEET_AFFINITY_HITS_TOTAL.value
+    # Seeded replica-kill plan: members are probed in order each health
+    # sweep (n_members "replica"-site calls per sweep), so call
+    # s * n_members crashes the LAST member on sweep s. One kill lands
+    # early (sweep 10, ~1s — often mid-compile, exercising 0-token
+    # failovers) and one mid-serving (sweep 45, ~4.5s) if the run lasts
+    # that long.
+    plan = FaultPlan([{"site": "replica", "kind": "exception",
+                      "at": [10 * n_members, 45 * n_members],
+                      "times": 2}], seed=7)
+    chaos = run_leg(n_members, plan, drain=True)
+
+    mismatches = [i for i, (a, b) in enumerate(zip(golden["texts"],
+                                                   chaos["texts"]))
+                  if a != b]
+    # A chaos stream that is a strict PREFIX of its golden twin AND ended
+    # with a normal done was silently truncated — the exact bug the
+    # zero-drop contract kills. (An explicit error terminal is loud, not
+    # silent — it still counts as a mismatch above.)
+    silent = sum(
+        1 for i in mismatches
+        if golden["texts"][i].startswith(chaos["texts"][i])
+        and chaos["terminals"][i] is not None
+        and chaos["terminals"][i].kind == "done")
+    dropped = sum(1 for t in chaos["terminals"] if t is None)
+    jrecs = chaos["journal"]
+    violations = check_invariants(jrecs) + check_no_dropped_streams(jrecs)
+    failover_rids = {r.get("req_id") for r in jrecs
+                     if r["kind"] == "replica_failover"}
+    failover_idx = [i for i, rid in enumerate(chaos["rids"])
+                    if rid in failover_rids]
+    outcomes: dict = {}
+    for t in chaos["terminals"]:
+        reason = (t.finish_reason.value
+                  if t is not None and t.finish_reason else "none")
+        outcomes[reason] = outcomes.get(reason, 0) + 1
+    placements = sum(1 for r in jrecs if r["kind"] == "place")
+    affinity_hits = int(tm.FLEET_AFFINITY_HITS_TOTAL.value - affinity0)
+    return {
+        "requests": n_total,
+        "replicas": n_members,
+        "max_new_tokens": max_new,
+        "ejects": sum(1 for r in jrecs if r["kind"] == "replica_eject"),
+        "failovers": chaos["failovers"],
+        "drains": sum(1 for r in jrecs if r["kind"] == "replica_drain"),
+        "rejoins": sum(1 for r in jrecs if r["kind"] == "replica_join"
+                       and r.get("why") != "start"),
+        "dropped_streams": dropped,
+        "silent_truncations": silent,
+        "stream_mismatches": len(mismatches),
+        "failover_streams": len(failover_idx),
+        "failover_streams_byte_identical": bool(failover_idx) and not any(
+            i in mismatches for i in failover_idx),
+        "placements": placements,
+        "affinity_hits": affinity_hits,
+        "affinity_hit_ratio": round(affinity_hits / max(1, placements), 4),
+        "invariant_violations": len(violations),
+        "outcomes": outcomes,
+        "elapsed_s_golden": golden["elapsed_s"],
+        "elapsed_s_chaos": chaos["elapsed_s"],
+    }
 
 
 def _overload_scenario(rt, core, args, rng, touch):

@@ -373,6 +373,19 @@ struct Tui {
       std::snprintf(l, sizeof l, " throughput %.0f tok/s   MFU --   %s   %s",
                     tok_rate > 0 ? tok_rate : 0.0, cache, degrade);
     out.push_back(std::string(CYAN) + l + RST);
+    /* Fleet replicas chip (only under a fleet router): N healthy / M
+     * ejected / K draining. Red when any member is out of rotation —
+     * capacity is reduced and streams may be mid-failover. */
+    auto fleet = stats->get("replicas");
+    if (fleet && fleet->type == mj::Value::OBJ) {
+      double fh = fleet->get("healthy") ? fleet->get("healthy")->as_num() : 0;
+      double fe = fleet->get("ejected") ? fleet->get("ejected")->as_num() : 0;
+      double fd = fleet->get("draining") ? fleet->get("draining")->as_num() : 0;
+      std::snprintf(l, sizeof l,
+                    " replicas %.0f healthy / %.0f ejected / %.0f draining",
+                    fh, fe, fd);
+      out.push_back(std::string(fe > 0 ? RED : CYAN) + l + RST);
+    }
     /* One row PER chip (pod-wide under SPMD): the north star's "per-chip
      * HBM occupancy" — a v5e-16 must not show chip 0 for the pod. */
     auto chips = stats->get("chips");

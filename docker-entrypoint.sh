@@ -17,6 +17,10 @@ set -- --no-tui --host 0.0.0.0
 [ -n "${PAGE_SIZE:-}" ] && set -- "$@" --page-size "$PAGE_SIZE"
 [ -n "${NUM_PAGES:-}" ] && set -- "$@" --num-pages "$NUM_PAGES"
 [ "${SPMD:-}" = "true" ] && set -- "$@" --spmd
+[ -n "${REPLICAS:-}" ] && set -- "$@" --replicas "$REPLICAS"
+[ -n "${REPLICA_URLS:-}" ] && set -- "$@" --replica-urls "$REPLICA_URLS"
+[ -n "${PLACEMENT:-}" ] && set -- "$@" --placement "$PLACEMENT"
+[ -n "${DRAIN_TIMEOUT_S:-}" ] && set -- "$@" --drain-timeout-s "$DRAIN_TIMEOUT_S"
 [ -n "${MAX_SLOTS:-}" ] && set -- "$@" --max-slots "$MAX_SLOTS"
 [ -n "${BLOCKLIST:-}" ] && set -- "$@" --blocklist "$BLOCKLIST"
 [ "${ALLOW_ALL_ROUTES:-}" = "true" ] && set -- "$@" --allow-all-routes

@@ -83,7 +83,7 @@ def _engine_stats_brief(engine) -> dict:
             last_decision = jr.last_summary()
         except Exception:
             last_decision = ""
-    return {
+    out = {
         "models": models,
         "device": _hbm_cache["device"] or "no-device",
         "chips": _hbm_cache["chips"],
@@ -94,6 +94,15 @@ def _engine_stats_brief(engine) -> dict:
         "last_decision": last_decision,
         "alerts": alerts,
     }
+    # Fleet replicas chip (N healthy / M ejected / K draining): present
+    # only when the engine is a fleet router.
+    fleet = getattr(engine, "fleet_counts", None)
+    if fleet is not None:
+        try:
+            out["replicas"] = fleet()
+        except Exception:
+            pass
+    return out
 
 
 def run_tui(engine, registry, refresh_ms: int = 100) -> None:

@@ -113,6 +113,35 @@ def build_parser() -> argparse.ArgumentParser:
                    help="GPipe microbatches per pp dispatch (0 = one per "
                         "stage; sweep on hardware — prefill wants more, "
                         "weight-bound decode may want fewer)")
+    # Fleet router: dispatcher-over-engines.
+    p.add_argument("--replicas", type=int,
+                   default=int(os.environ.get("REPLICAS", 1)),
+                   help="in-process engine replicas behind the fleet "
+                        "router (1 = single engine, no router): health-"
+                        "driven ejection with backoff re-probe, mid-"
+                        "stream failover replaying prompt + emitted "
+                        "tokens, POST /admin/drain/{replica} zero-drop "
+                        "rolling restarts")
+    p.add_argument("--replica-urls",
+                   default=os.environ.get("REPLICA_URLS", ""),
+                   help="comma-separated base URLs of subprocess/remote "
+                        "engines speaking the existing HTTP API, joined "
+                        "to the fleet as members (the docker-compose "
+                        "'router + engine services' shape); combines "
+                        "with --replicas local members")
+    p.add_argument("--placement", choices=("affinity", "least_loaded"),
+                   default=os.environ.get("PLACEMENT", "affinity"),
+                   help="fleet placement policy: 'affinity' routes to "
+                        "the replica whose prefix-cache radix tree "
+                        "already holds the prompt's prefix, falling "
+                        "back to least-loaded (with round-robin tie "
+                        "rotation); 'least_loaded' skips the probe")
+    p.add_argument("--drain-timeout-s", type=float,
+                   default=float(os.environ.get("DRAIN_TIMEOUT_S", 30.0)),
+                   help="drain budget: in-flight streams get this long "
+                        "to finish on a draining replica before the "
+                        "stragglers fail over (still zero dropped "
+                        "streams)")
     # Graceful degradation under load.
     p.add_argument("--max-queued", type=int, default=0,
                    help="global queued-request cap: past it, enqueues are "
@@ -276,6 +305,14 @@ def main(argv=None) -> int:
         log.error("--journal-rotate-mb / --log-rotate-mb must be >= 0 "
                   "(0 disables rotation)")
         return 2
+    fleet_urls = [u.strip() for u in args.replica_urls.split(",")
+                  if u.strip()]
+    if args.replicas < 0 or (args.replicas == 0 and not fleet_urls):
+        log.error("--replicas must be >= 1 (0 only with --replica-urls)")
+        return 2
+    if args.drain_timeout_s <= 0:
+        log.error("--drain-timeout-s must be > 0")
+        return 2
     # Quantization flags fail fast BEFORE any device/runtime work: an
     # unsupported combination must kill the process at startup, not at
     # the first dispatch (same validator the SPMD worker and the
@@ -377,13 +414,53 @@ def main(argv=None) -> int:
         journal_keep=args.journal_keep,
         weights_dtype=args.weights_dtype,
         kv_dtype=args.kv_dtype,
+        replicas=args.replicas,
+        placement=args.placement,
+        drain_timeout_s=args.drain_timeout_s,
     )
     fairness = Fairness.TOKENS if args.token_fairness else Fairness.REQUESTS
 
     if args.spmd and args.fake_engine:
         log.error("--spmd and --fake-engine are mutually exclusive")
         return 2
-    if args.spmd:
+    if (args.replicas > 1 or fleet_urls) and args.spmd:
+        log.error("--replicas/--replica-urls and --spmd are mutually "
+                  "exclusive (the SPMD engine already owns a worker pool; "
+                  "run the fleet router over separate SPMD services via "
+                  "--replica-urls from a non-SPMD front-end instead)")
+        return 2
+    if args.replicas > 1 or fleet_urls:
+        import dataclasses
+
+        from ollamamq_tpu.fleet import FleetRouter, HttpMember, LocalMember
+
+        # Members serve uncapped what the router placed (the router owns
+        # the fleet-wide bounded-admission caps), keep no blocklist (the
+        # router blocks at ingress), and leave the journal spill to the
+        # router's fleet journal.
+        member_cfg = dataclasses.replace(
+            ecfg, max_queued=0, max_queued_per_user=0, journal_file=None)
+        members = []
+        for i in range(args.replicas):
+            if args.fake_engine:
+                from ollamamq_tpu.engine.fake import FakeEngine
+
+                eng = FakeEngine(member_cfg, models=models,
+                                 blocklist_path=None, fairness=fairness)
+            else:
+                from ollamamq_tpu.engine.engine import TPUEngine
+
+                eng = TPUEngine(member_cfg, models=models,
+                                blocklist_path=None, fairness=fairness)
+            members.append(LocalMember(f"r{i}", eng))
+        for j, url in enumerate(fleet_urls):
+            members.append(HttpMember(f"h{j}", url,
+                                      timeout_s=args.timeout))
+        engine = FleetRouter(
+            members, ecfg, blocklist_path=args.blocklist,
+            fairness=fairness, placement=args.placement,
+            drain_timeout_s=args.drain_timeout_s)
+    elif args.spmd:
         import jax
 
         from ollamamq_tpu.parallel.mesh import make_mesh

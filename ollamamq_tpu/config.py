@@ -321,6 +321,18 @@ class EngineConfig:
     # Deterministic fault injection (testing/faults.py): path to a plan
     # file, or a FaultPlan instance (tests). None = no injection.
     fault_plan: Optional[object] = None
+    # -- fleet router (fleet/router.py) --------------------------------------
+    # Engine replicas behind the front-end router (1 = single engine, no
+    # router). The router owns the fair-share queues and the bounded-
+    # admission caps; members serve uncapped what the router placed.
+    replicas: int = 1
+    # Placement policy: "affinity" routes to the replica whose prefix-
+    # cache radix tree already holds the prompt's prefix (falling back
+    # to least-loaded); "least_loaded" skips the affinity probe.
+    placement: str = "affinity"
+    # POST /admin/drain/{replica}: in-flight streams get this long to
+    # complete before the stragglers fail over to healthy replicas.
+    drain_timeout_s: float = 30.0
     # -- flight recorder (telemetry/journal.py) ------------------------------
     # Decision-journal ring capacity (records retained for /debug/journal
     # and the health monitor's invariant sweep).

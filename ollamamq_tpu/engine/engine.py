@@ -3313,6 +3313,52 @@ class TPUEngine:
             return
         self.notify()
 
+    def inject_request(self, req: Request, ip: str = "",
+                       family=None) -> Request:
+        """Fleet handoff seam: atomically enqueue AND register a
+        PRE-BUILT Request (the fleet router's attempt objects, which may
+        carry replayed generation state — generated_ids, detokenizer,
+        penalty context folded into the prompt — that enqueue_request
+        could not construct). Bypasses bounded admission on purpose: the
+        router owns the fleet-wide caps; a member must never second-guess
+        a placement the router already admitted."""
+        with self._pending_lock:
+            rid = self.core.enqueue(
+                req.user, ip, req.model,
+                family if family is not None else Family.UNKNOWN,
+                kind=req.kind)
+            req.req_id = rid
+            self.pending[rid] = req
+        self.journal.record(
+            "enqueue", req=req, n_prompt=len(req.prompt_tokens),
+            queued=self.core.total_queued(), kind_req=req.kind,
+            max_tokens=req.sampling.max_tokens)
+        self.notify()
+        return req
+
+    def prefix_match_pages(self, model: str, tokens) -> int:
+        """Longest cached-prefix match (in full pages) any runtime of
+        `model` holds for this prompt — the fleet router's placement-
+        affinity probe. Advisory read from another thread: the radix walk
+        only follows dict gets under the GIL, so a racing engine-loop
+        mutation can at worst return a stale count (a placement-quality
+        issue, never a correctness one). 0 when nothing caches."""
+        rt = self.resolve_runtime(model)
+        if rt is None:
+            return 0
+        reps = rt.replicas if isinstance(rt, ReplicaSet) else [rt]
+        best = 0
+        for rep in reps:
+            pc = getattr(rep, "prefix_cache", None)
+            if pc is None:
+                continue
+            try:
+                _nodes, pages = pc.match(list(tokens))
+            except Exception:  # noqa: BLE001 — advisory probe only
+                continue
+            best = max(best, len(pages))
+        return best
+
     def _count_shed(self, reason: str) -> None:
         tm.SHED_TOTAL.labels(reason=reason).inc()
         self.shed_counts[reason] = self.shed_counts.get(reason, 0) + 1

@@ -231,6 +231,20 @@ class HealthMonitor:
             f"SPMD worker host(s) {stale} stopped publishing registry "
             "snapshots/heartbeats", "worker_host")
 
+        # Fleet-level analogue of worker_stale: engine replicas whose
+        # heartbeat went stale or that the router ejected from rotation
+        # (fleet/router.py stale_replicas). Single-engine deployments
+        # have no such hook and skip this check entirely.
+        stale_reps = []
+        reps_fn = getattr(self.engine, "stale_replicas", None)
+        if reps_fn is not None:
+            stale_reps = reps_fn() or []
+        self._alert(
+            "replica_stale", bool(stale_reps), "page",
+            f"fleet replica(s) {stale_reps} heartbeat-stale or ejected "
+            "from rotation (in-flight streams fail over; capacity is "
+            "reduced until they heal)", "replica")
+
         self._check_preempt_storm()
         self._check_journal_invariants()
 

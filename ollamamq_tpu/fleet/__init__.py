@@ -1,0 +1,4 @@
+from ollamamq_tpu.fleet.members import HttpMember, LocalMember
+from ollamamq_tpu.fleet.router import FleetRouter
+
+__all__ = ["FleetRouter", "LocalMember", "HttpMember"]

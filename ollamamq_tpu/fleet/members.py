@@ -1,0 +1,473 @@
+"""Fleet members: the engine replicas a FleetRouter places streams on.
+
+Two shapes, one protocol:
+
+  LocalMember  wraps an in-process engine (TPUEngine / FakeEngine /
+               SPMDEngine) — the replica runs its own scheduler loop,
+               KV pool, and health monitor inside this process. Replay
+               is exact: a failed-over stream carries its generated
+               token ids, incremental detokenizer, and penalty context
+               (the PR-4 preemption/replay semantics lifted to fleet
+               level), so greedy resumed streams are byte-identical.
+  HttpMember   wraps a subprocess/remote engine speaking the existing
+               HTTP API (the docker-compose "two engine services"
+               shape). Health rides the member's /health JSON polled on
+               a heartbeat; streams ride /api/generate NDJSON consumed
+               by a reader thread; replay is text-level (prompt +
+               already-emitted text, token budget shrunk by the emitted
+               count) — exact for byte-level tokenizers, best-effort
+               where detokenization is context-dependent.
+
+The router is the ONLY consumer of an attempt's TokenStream: member-side
+terminal items (including the CANCELLED ack of an eviction) are routing
+signals, not client output — the router decides what the client stream
+sees.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import logging
+import threading
+import time
+import urllib.request
+from typing import Optional
+
+from ollamamq_tpu.engine.request import FinishReason, Request, StreamItem
+
+log = logging.getLogger("ollamamq.fleet")
+
+# Alerts that mean a replica cannot be trusted with new placements (the
+# /health JSON "degraded" status alone must NOT eject: an SLO burning is
+# pressure, not death — app.py /health makes the same distinction).
+FATAL_ALERTS = frozenset({"device_offline", "engine_stall"})
+
+_REASONS = {r.value: r for r in FinishReason}
+
+
+class Attempt:
+    """One member-side serving attempt of a client stream. `req` is the
+    member-side Request whose TokenStream the router drains; the client
+    never sees this object."""
+
+    __slots__ = ("req", "member", "acked", "closed", "transport_dead",
+                 "base_n", "n_items", "text_mode", "prior_text",
+                 "text_parts", "thread", "resp", "embedding_val")
+
+    def __init__(self, req: Request, member) -> None:
+        self.req = req
+        self.member = member
+        self.acked = False           # member confirmed our eviction
+        self.closed = False          # router asked this attempt to stop
+        self.transport_dead = False  # HTTP stream died mid-flight
+        self.base_n = 0              # tokens emitted by PRIOR attempts
+        self.n_items = 0             # token items this attempt emitted
+        self.text_mode = False       # replay state is text, not token ids
+        self.prior_text = ""         # text emitted by prior attempts
+        self.text_parts: list = []
+        self.thread: Optional[threading.Thread] = None
+        self.resp = None
+        self.embedding_val = None
+
+    def tokens_done(self) -> int:
+        if self.text_mode:
+            return self.base_n + self.n_items
+        return len(self.req.generated_ids)
+
+    def embedding(self):
+        return self.embedding_val if self.text_mode else self.req.embedding
+
+    def reader_dead(self) -> bool:
+        return self.thread is not None and not self.thread.is_alive()
+
+    def resume_state(self) -> dict:
+        """Replay state for the NEXT attempt of this stream: everything a
+        healthy replica needs to continue it seamlessly."""
+        req = self.req
+        if self.text_mode:
+            return {"gen_ids": None,
+                    "n_gen": self.base_n + self.n_items,
+                    "text": self.prior_text + "".join(self.text_parts)}
+        return {"gen_ids": list(req.generated_ids),
+                "n_gen": len(req.generated_ids),
+                "inc": req._inc_decode,
+                "detok": req._detok_text,
+                "emitted": req.emitted_len,
+                # Full emitted text, for a cross-shape (local -> HTTP)
+                # failover that can only replay in text space.
+                "text": req._detok_text[:req.emitted_len]}
+
+
+class _MemberBase:
+    """State the router tracks per member regardless of shape."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.state = "healthy"       # healthy | ejected | draining
+        self.backoff_s = 0.0         # set by the router at eject time
+        self.next_probe_at = 0.0
+        self.eject_count = 0
+        self.drain_started_at = 0.0
+        self.drain_deadline = 0.0
+        self.forced_stale_until = 0.0  # fault site "replica", kind "slow"
+
+    def force_stale(self, delay_s: float) -> None:
+        self.forced_stale_until = time.monotonic() + float(delay_s)
+
+
+class LocalMember(_MemberBase):
+    """An in-process engine replica. The engine was constructed by the
+    caller (cli/tests) and is started/stopped through this wrapper."""
+
+    kind_label = "local"
+    router_bounded = False  # the engine's own capacity gate bounds intake
+
+    def __init__(self, name: str, engine) -> None:
+        super().__init__(name)
+        self.engine = engine
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self.engine.start()
+
+    def stop(self) -> None:
+        self.engine.stop()
+
+    def crash(self) -> None:
+        """Abrupt loop death (fault injection / observed failure): the
+        loop thread exits after its current iteration — deliberately NOT
+        a clean stop(), which would join and tidy up the very state a
+        real crash leaves behind."""
+        self.engine._running = False
+        self.engine.notify()
+
+    def restart(self) -> None:
+        """Hot restart after a crash or heal: the loop thread (and the
+        member's health monitor) come back over the SAME runtimes —
+        weights stay resident. The OLD loop thread must be fully dead
+        first: it may still be inside a long iteration (a compile, a
+        wedged dispatch), and starting a second loop would reset
+        _running to True — the zombie then keeps looping, and two loops
+        dispatching over the same donated KV buffers poison the runtime
+        ("Array has been deleted"). Waits briefly for the first liveness
+        tick so the caller's health evaluation sees a fresh heartbeat."""
+        old = self.engine._thread
+        if old is not None and old.is_alive():
+            old.join(timeout=5.0)
+            if old.is_alive():
+                return  # still wedged: stay ejected, re-probe later
+        self.engine._thread = None
+        self.engine.start()
+        deadline = time.monotonic() + 1.0
+        while (time.monotonic() - self.engine.last_tick_at > 0.5
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+
+    def hot_restart(self) -> None:
+        """Drain-complete restart: clean stop (nothing in flight) then
+        start — the rolling-restart primitive."""
+        self.engine.stop()
+        self.engine.start()
+
+    # -- health ------------------------------------------------------------
+    def alive(self) -> bool:
+        eng = self.engine
+        return bool(eng._running and eng._thread is not None
+                    and eng._thread.is_alive())
+
+    def heartbeat_age(self) -> float:
+        now = time.monotonic()
+        if now < self.forced_stale_until:
+            return float("inf")
+        return now - self.engine.last_tick_at
+
+    def fatal_alerts(self) -> list:
+        alerts = getattr(self.engine, "alerts", None)
+        if alerts is None:
+            return []
+        return [a.name for a in alerts.active() if a.name in FATAL_ALERTS]
+
+    def active_alerts(self) -> list:
+        alerts = getattr(self.engine, "alerts", None)
+        if alerts is None:
+            return []
+        return [(a.name, a.severity) for a in alerts.active()]
+
+    # -- placement ---------------------------------------------------------
+    def can_take(self, model: str, kind: str) -> bool:
+        eng = self.engine
+        rt = eng.resolve_runtime(model, kind=kind)
+        if rt is None:
+            return False
+        probe = rt.replicas[0] if hasattr(rt, "replicas") else rt
+        if kind not in getattr(probe, "SERVES", ("generate",)):
+            return False
+        return rt.has_capacity(kind)
+
+    def affinity_pages(self, model: str, tokens) -> int:
+        fn = getattr(self.engine, "prefix_match_pages", None)
+        return fn(model, tokens) if fn is not None else 0
+
+    # -- streams -----------------------------------------------------------
+    def _tokenize(self, model: str, text: str):
+        rt = self.engine.resolve_runtime(model)
+        if rt is None:
+            from ollamamq_tpu.engine.tokenizer import ByteTokenizer
+
+            return ByteTokenizer().encode(text, add_bos=True)
+        return rt.tokenizer.encode(text, add_bos=True)
+
+    def begin(self, flight, resume: Optional[dict], on_item=None) -> Attempt:
+        sampling = flight.sampling
+        if resume and resume.get("gen_ids") is not None:
+            # Token-space replay: prompt + every already-emitted token,
+            # generation state carried over — the engine's own
+            # preemption-replay convention (generated_ids pre-filled, so
+            # LENGTH accounting and the fake engine's resume-awareness
+            # both hold; the incremental detokenizer never re-sees the
+            # replayed ids).
+            gen = list(resume["gen_ids"])
+            req = Request(0, flight.user, flight.model,
+                          list(flight.prompt_tokens) + gen, sampling,
+                          kind=flight.kind, raw_prompt=flight.raw_prompt)
+            req.generated_ids = list(gen)
+            req._replay_gen = len(gen)
+            req._inc_decode = resume.get("inc")
+            req._detok_text = resume.get("detok", "")
+            req.emitted_len = resume.get("emitted", 0)
+        elif resume:
+            # Text-space replay (stream previously served over HTTP):
+            # fold the emitted text into the prompt and shrink the budget.
+            n_gen = int(resume.get("n_gen", 0))
+            tokens = self._tokenize(
+                flight.model, flight.raw_prompt + resume.get("text", ""))
+            sampling = copy.copy(sampling)  # copy.copy skips __post_init__
+            sampling.max_tokens = max(1, sampling.max_tokens - n_gen)
+            req = Request(0, flight.user, flight.model, tokens, sampling,
+                          kind=flight.kind, raw_prompt=flight.raw_prompt)
+        else:
+            req = Request(0, flight.user, flight.model,
+                          list(flight.prompt_tokens), sampling,
+                          kind=flight.kind, raw_prompt=flight.raw_prompt)
+        # The client's deadline is absolute; the attempt must not get a
+        # fresh budget just because it re-enqueued later.
+        req.deadline = flight.req.deadline
+        if on_item is not None:
+            req.stream.on_item = on_item
+        att = Attempt(req, self)
+        if resume and resume.get("gen_ids") is None:
+            att.text_mode = True
+            att.base_n = int(resume.get("n_gen", 0))
+            att.prior_text = resume.get("text", "")
+        self.engine.inject_request(req, ip=flight.ip, family=flight.family)
+        return att
+
+    def cancel(self, att: Attempt) -> None:
+        att.closed = True
+        att.req.cancelled.set()
+        try:
+            self.engine.cancel(att.req.req_id)
+        except Exception:  # noqa: BLE001 — a dead member must not block evac
+            log.exception("cancel on member %s failed", self.name)
+
+
+class HttpMember(_MemberBase):
+    """A remote engine replica speaking the existing HTTP API. Health is
+    the member's /health JSON polled on a heartbeat cadence; staleness =
+    no successful poll recently."""
+
+    kind_label = "http"
+    router_bounded = True  # no capacity introspection over HTTP
+
+    def __init__(self, name: str, url: str, timeout_s: float = 300.0,
+                 poll_period_s: float = 1.0) -> None:
+        super().__init__(name)
+        self.url = url.rstrip("/")
+        self.timeout_s = timeout_s
+        self.poll_period_s = poll_period_s
+        self._forced_down = False
+        self._last_ok = time.monotonic()
+        self._status: dict = {}
+        self._stop = threading.Event()
+        self._poller: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self._poller is None:
+            self._stop.clear()
+            self._poller = threading.Thread(
+                target=self._poll_loop, name=f"fleet-poll-{self.name}",
+                daemon=True)
+            self._poller.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._poller is not None:
+            self._poller.join(timeout=2)
+            self._poller = None
+
+    def crash(self) -> None:
+        # Fault injection can't kill a remote process; it marks the
+        # member down so the router's eject/failover path still runs.
+        self._forced_down = True
+
+    def restart(self) -> None:
+        self._forced_down = False
+
+    def hot_restart(self) -> None:
+        # The remote process restarts itself (rolling deploy); drain's
+        # job here was only to quiesce placements first.
+        self._forced_down = False
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.poll_period_s):
+            try:
+                with urllib.request.urlopen(self.url + "/health",
+                                            timeout=2.0) as resp:
+                    self._status = json.loads(resp.read())
+                self._last_ok = time.monotonic()
+            except Exception:  # noqa: BLE001 — staleness IS the signal
+                pass
+
+    # -- health ------------------------------------------------------------
+    def alive(self) -> bool:
+        return not self._forced_down
+
+    def heartbeat_age(self) -> float:
+        now = time.monotonic()
+        if now < self.forced_stale_until or self._forced_down:
+            return float("inf")
+        return now - self._last_ok
+
+    def fatal_alerts(self) -> list:
+        return [a.get("name") for a in self._status.get("alerts", ())
+                if a.get("name") in FATAL_ALERTS]
+
+    def active_alerts(self) -> list:
+        return [(a.get("name"), a.get("severity"))
+                for a in self._status.get("alerts", ())]
+
+    # -- placement ---------------------------------------------------------
+    def can_take(self, model: str, kind: str) -> bool:
+        return True  # the router bounds in-flight per HTTP member
+
+    def affinity_pages(self, model: str, tokens) -> int:
+        return 0  # no cross-process radix probe; falls back to least-loaded
+
+    # -- streams -----------------------------------------------------------
+    def begin(self, flight, resume: Optional[dict], on_item=None) -> Attempt:
+        n_prior = int(resume.get("n_gen", 0)) if resume else 0
+        prior_text = resume.get("text", "") if resume else ""
+        req = Request(0, flight.user, flight.model, [], flight.sampling,
+                      kind=flight.kind,
+                      raw_prompt=flight.raw_prompt + prior_text)
+        if on_item is not None:
+            req.stream.on_item = on_item
+        att = Attempt(req, self)
+        att.text_mode = True
+        att.base_n = n_prior
+        att.prior_text = prior_text
+        att.thread = threading.Thread(
+            target=self._reader, args=(att, flight, n_prior),
+            name=f"fleet-{self.name}-r{flight.rid0}", daemon=True)
+        att.thread.start()
+        return att
+
+    def _options(self, sampling, remaining: int) -> dict:
+        opts = {
+            "num_predict": remaining,
+            "temperature": sampling.temperature,
+            "top_k": sampling.top_k,
+            "top_p": sampling.top_p,
+            "repeat_penalty": sampling.repeat_penalty,
+            "presence_penalty": sampling.presence_penalty,
+            "frequency_penalty": sampling.frequency_penalty,
+        }
+        if sampling.stop:
+            opts["stop"] = list(sampling.stop)
+        if sampling.seed:
+            opts["seed"] = sampling.seed
+        return opts
+
+    def _reader(self, att: Attempt, flight, n_prior: int) -> None:
+        """(reader thread) Drive one streamed member request, pushing
+        items into the attempt stream. A transport failure pushes
+        NOTHING terminal: a dead connection is the failover trigger, not
+        a client-visible error — the router notices transport_dead and
+        re-dispatches the stream."""
+        stream = att.req.stream
+        try:
+            if flight.kind == "embed":
+                body = {"model": flight.model, "input": flight.raw_prompt}
+                httpreq = urllib.request.Request(
+                    self.url + "/api/embed",
+                    data=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json",
+                             "X-User-ID": flight.user}, method="POST")
+                with urllib.request.urlopen(httpreq,
+                                            timeout=self.timeout_s) as resp:
+                    out = json.loads(resp.read())
+                vecs = out.get("embeddings") or []
+                att.embedding_val = vecs[0] if vecs else []
+                stream.push(StreamItem("done", finish_reason=FinishReason.STOP))
+                return
+            remaining = max(1, flight.sampling.max_tokens - n_prior)
+            body = {"model": flight.model, "prompt": att.req.raw_prompt,
+                    "stream": True,
+                    "options": self._options(flight.sampling, remaining)}
+            headers = {"Content-Type": "application/json",
+                       "X-User-ID": flight.user}
+            if flight.req.deadline is not None:
+                left_ms = (flight.req.deadline - time.monotonic()) * 1e3
+                headers["X-Deadline-Ms"] = str(max(1.0, left_ms))
+            httpreq = urllib.request.Request(
+                self.url + "/api/generate", data=json.dumps(body).encode(),
+                headers=headers, method="POST")
+            att.resp = urllib.request.urlopen(httpreq, timeout=self.timeout_s)
+            for raw in att.resp:
+                if att.closed:
+                    return
+                try:
+                    obj = json.loads(raw)
+                except json.JSONDecodeError:
+                    continue
+                if obj.get("error"):
+                    reason = _REASONS.get(obj.get("done_reason", ""),
+                                          FinishReason.ERROR)
+                    stream.push(StreamItem("error", finish_reason=reason,
+                                           error=str(obj["error"])))
+                    return
+                txt = obj.get("response", "")
+                if txt:
+                    att.n_items += 1
+                    att.text_parts.append(txt)
+                    stream.push(StreamItem("token", text=txt))
+                if obj.get("done"):
+                    reason = _REASONS.get(obj.get("done_reason", "stop"),
+                                          FinishReason.STOP)
+                    stream.push(StreamItem("done", finish_reason=reason))
+                    return
+            # Stream ended without a done line: the member died mid-write.
+            att.transport_dead = True
+        except Exception as e:  # noqa: BLE001
+            if not att.closed:
+                log.warning("member %s stream for req %s died: %s",
+                            self.name, flight.rid0, e)
+                att.transport_dead = True
+        finally:
+            resp = att.resp
+            if resp is not None:
+                try:
+                    resp.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def cancel(self, att: Attempt) -> None:
+        att.closed = True
+        resp = att.resp
+        if resp is not None:
+            try:
+                resp.close()  # member sees the disconnect and cancels
+            except Exception:  # noqa: BLE001
+                pass

@@ -1,0 +1,960 @@
+"""Fleet router: a thin dispatcher-over-engines front-end.
+
+The source dispatcher's reason to exist is serving DESPITE backend churn
+(dispatcher.rs health loop: probe, eject, re-dispatch, least-loaded
+placement). This module is that role over N engine replicas:
+
+  - the router owns the per-user fair-share queues (its own native
+    MQCore + blocklist) and the fleet-wide bounded-admission caps;
+    members never second-guess an admitted placement;
+  - placement is least-loaded with optional prefix-cache affinity
+    (--placement=affinity, the default: route to the replica whose
+    radix tree already holds the prompt's prefix, falling back to
+    least-loaded with round-robin tie rotation);
+  - replica health = the member's /health alert table + heartbeat
+    staleness; an unhealthy member is EJECTED from rotation and
+    re-probed with exponential backoff before re-admission;
+  - when a replica dies or is ejected mid-stream, its victim streams
+    FAIL OVER: the router replays prompt + every already-emitted token
+    on a healthy replica (the PR-4 preemption/replay semantics lifted
+    to fleet level) so the client sees one seamless stream — greedy
+    streams are byte-identical to an unkilled run on local members;
+  - POST /admin/drain/{replica} quiesces a member: no new placements,
+    in-flight streams run to completion (stragglers past the drain
+    timeout fail over), then hot-restart and rejoin — rolling restarts
+    drop nothing.
+
+Every fleet decision is journaled (replica_eject / replica_failover /
+replica_drain / replica_join) with the inputs that justified it, under
+the STREAM's original router request id — stable across failovers and
+requeues — so tools/journal.py can audit that no stream a replica
+failure touched was ever dropped.
+
+The router presents the same surface the HTTP server expects of an
+engine (core / enqueue_request / cancel / stats / alerts / journal /
+tracer / health ...), so server/app.py serves a fleet unchanged.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ollamamq_tpu.core import Fairness, MQCore
+from ollamamq_tpu.core.mqcore import BlockedError, Family, StuckQueue
+from ollamamq_tpu.engine.engine import QueueFullError
+from ollamamq_tpu.engine.request import FinishReason, Request
+from ollamamq_tpu.fleet.members import HttpMember, LocalMember  # noqa: F401
+from ollamamq_tpu.telemetry import schema as tm
+from ollamamq_tpu.telemetry.journal import Journal
+from ollamamq_tpu.telemetry.slo import AlertManager, SLOEngine
+from ollamamq_tpu.telemetry.tracing import Tracer
+
+log = logging.getLogger("ollamamq.fleet")
+
+# Health-loop defaults (constructor-overridable; tests shrink them).
+PROBE_PERIOD_S = 0.25        # member health sweep cadence
+EJECT_HEARTBEAT_S = 3.0      # heartbeat staleness that ejects a member
+REPROBE_BACKOFF_S = 0.5      # first re-probe delay after ejection...
+REPROBE_BACKOFF_MAX_S = 30.0  # ...doubling per failed probe up to this
+EVAC_GRACE_S = 2.0           # max wait for a dying member to ack eviction
+
+
+class _Flight:
+    """One client stream through the fleet: the router-owned Request the
+    server consumes, plus its current member attempt. `rid0` is the
+    stream's stable identity in the router journal (req.req_id rotates
+    on requeue; the audit trail must not)."""
+
+    __slots__ = ("req", "rid0", "user", "ip", "model", "family", "kind",
+                 "raw_prompt", "prompt_tokens", "sampling", "member",
+                 "attempt", "resume", "failed_from", "evac_since",
+                 "evac_deadline", "begin_failures", "done")
+
+    def __init__(self, req: Request, ip: str, family) -> None:
+        self.req = req
+        self.rid0 = req.req_id
+        self.user = req.user
+        self.ip = ip
+        self.model = req.model
+        self.family = family
+        self.kind = req.kind
+        self.raw_prompt = req.raw_prompt
+        self.prompt_tokens = list(req.prompt_tokens)
+        self.sampling = req.sampling
+        self.member = None
+        self.attempt = None
+        self.resume: Optional[dict] = None
+        self.failed_from: Optional[str] = None
+        self.evac_since: Optional[float] = None
+        self.evac_deadline = 0.0
+        self.begin_failures = 0
+        self.done = False
+
+
+class FleetRouter:
+    """Engine-shaped facade over N members; see module docstring."""
+
+    def __init__(self, members: List[object], engine_cfg,
+                 blocklist_path: Optional[str] = "blocked_items.json",
+                 fairness: Fairness = Fairness.REQUESTS,
+                 placement: str = "affinity",
+                 drain_timeout_s: float = 30.0,
+                 probe_period_s: float = PROBE_PERIOD_S,
+                 eject_heartbeat_s: float = EJECT_HEARTBEAT_S,
+                 reprobe_backoff_s: float = REPROBE_BACKOFF_S,
+                 evac_grace_s: float = EVAC_GRACE_S):
+        assert members, "a fleet needs at least one member"
+        if placement not in ("affinity", "least_loaded"):
+            raise ValueError(f"unknown placement policy {placement!r} "
+                             "(want 'affinity' or 'least_loaded')")
+        self.members = list(members)
+        names = [m.name for m in self.members]
+        assert len(set(names)) == len(names), "member names must be unique"
+        self.ecfg = engine_cfg
+        self.placement = placement
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.probe_period_s = float(probe_period_s)
+        self.eject_heartbeat_s = float(eject_heartbeat_s)
+        self.reprobe_backoff_s = float(reprobe_backoff_s)
+        self.evac_grace_s = float(evac_grace_s)
+        self.core = MQCore(blocklist_path)
+        self.core.set_fairness(fairness)
+        self.pending: Dict[int, _Flight] = {}  # queued, keyed by CURRENT rid
+        self.flights: List[_Flight] = []       # placed, loop-thread-owned
+        self._pending_lock = threading.Lock()
+        self._cond = threading.Condition()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self.started_at = time.time()
+        self.last_tick_at = time.monotonic()
+        self.tracer = Tracer(capacity=engine_cfg.trace_ring)
+        self.alerts = AlertManager()
+        # The router's SLOEngine exists for the shared alert/evaluate
+        # surface; latency objectives stay member-side (each member's
+        # runtimes record into its own SLOEngine) to avoid double-counting
+        # the global ollamamq_slo_* series.
+        self.slo = SLOEngine(self.alerts)
+        self.journal = Journal(
+            capacity=engine_cfg.journal_ring,
+            path=engine_cfg.journal_file,
+            rotate_bytes=int(engine_cfg.journal_rotate_mb * 1e6),
+            keep=engine_cfg.journal_keep,
+            meta={"fleet": len(self.members), "placement": placement,
+                  "model": engine_cfg.model})
+        self.health = None
+        self.shed_counts: Dict[str, int] = {}
+        self.failover_count = 0
+        self._rr = 0  # least-loaded tie-rotation cursor
+        self._last_probe = 0.0
+        self._last_stuck_log = 0.0
+        self._plan_down: set = set()  # members downed by a device_loss rule
+        self._mirrored: Dict[str, set] = {}  # member -> mirrored alert names
+        self._model_names = [engine_cfg.model] if engine_cfg.model else []
+        self.fault_plan = None
+        if engine_cfg.fault_plan:
+            from ollamamq_tpu.testing.faults import FaultPlan
+
+            self.fault_plan = (
+                FaultPlan.load(engine_cfg.fault_plan)
+                if isinstance(engine_cfg.fault_plan, str)
+                else engine_cfg.fault_plan)
+        for mem in self.members:
+            self.journal.record("replica_join", replica=mem.name,
+                                why="start")
+        self._update_gauges()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        for mem in self.members:
+            mem.start()
+        self._thread = threading.Thread(target=self._loop, name="fleet",
+                                        daemon=True)
+        self._thread.start()
+        if self.health is None:
+            from ollamamq_tpu.engine.health import HealthMonitor
+
+            self.health = HealthMonitor(self)
+            self.health.start()
+
+    def stop(self) -> None:
+        self._running = False
+        self.notify()
+        if self._thread:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if self.health is not None:
+            self.health.stop()
+            self.health = None
+        for mem in self.members:
+            try:
+                mem.stop()
+            except Exception:  # noqa: BLE001
+                log.exception("stopping member %s failed", mem.name)
+        self.journal.close()
+
+    def notify(self) -> None:
+        with self._cond:
+            self._cond.notify()
+
+    # -------------------------------------------------------- engine facade
+    @property
+    def local_members(self) -> List[LocalMember]:
+        return [m for m in self.members if isinstance(m, LocalMember)]
+
+    @property
+    def runtimes(self) -> dict:
+        """Merged member runtimes keyed uniquely (model@member) — the
+        health monitor's progress check and the TUI read this. Ejected
+        members are excluded: their parked work must not read as an
+        engine-wide stall."""
+        out = {}
+        for mem in self.local_members:
+            if mem.state == "ejected":
+                continue
+            for name, rt in mem.engine.runtimes.items():
+                out[f"{name}@{mem.name}"] = rt
+        return out
+
+    def loaded_models(self) -> List[str]:
+        locals_ = self.local_members
+        if locals_:
+            return locals_[0].engine.loaded_models()
+        return list(self._model_names)
+
+    def load_model(self, name: str, checkpoint_path: Optional[str] = None):
+        if not self.local_members:
+            raise NotImplementedError(
+                "runtime pull is not supported for HTTP fleet members; "
+                "load models on the member services")
+        for mem in self.local_members:
+            mem.engine.load_model(name, checkpoint_path)
+        if name not in self._model_names:
+            self._model_names.append(name)
+
+    def evict_model(self, name: str) -> bool:
+        ok = False
+        for mem in self.local_members:
+            ok = mem.engine.evict_model(name) or ok
+        return ok
+
+    def resolve_runtime(self, model: str, kind: str = "generate"):
+        for mem in self.local_members:
+            if mem.state != "ejected":
+                rt = mem.engine.resolve_runtime(model, kind=kind)
+                if rt is not None:
+                    return rt
+        for mem in self.local_members:
+            rt = mem.engine.resolve_runtime(model, kind=kind)
+            if rt is not None:
+                return rt
+        return None
+
+    def chip_stats(self) -> List[dict]:
+        locals_ = self.local_members
+        return locals_[0].engine.chip_stats() if locals_ else []
+
+    def worker_metric_snapshots(self) -> List[dict]:
+        return []  # members share this process's registry
+
+    def stale_worker_hosts(self) -> List[int]:
+        return []
+
+    def stale_replicas(self) -> List[str]:
+        """Members out of rotation or heartbeat-stale — the fleet-level
+        analogue of stale_worker_hosts; the health watchdog raises
+        `replica_stale` (kind="replica") from this."""
+        out = []
+        for mem in self.members:
+            if mem.state == "ejected" \
+                    or mem.heartbeat_age() > self.eject_heartbeat_s:
+                out.append(mem.name)
+        return out
+
+    def preemption_count(self) -> int:
+        return sum(mem.engine.preemption_count()
+                   for mem in self.local_members)
+
+    def retry_count(self) -> int:
+        return sum(mem.engine.retry_count() for mem in self.local_members)
+
+    def prefix_cache_stats(self) -> dict:
+        from ollamamq_tpu.engine.engine import merge_prefix_cache_stats
+
+        per_model: Dict[str, list] = {}
+        for mem in self.local_members:
+            stats = mem.engine.prefix_cache_stats()
+            for name, row in (stats.get("models") or {}).items():
+                if row is not None:
+                    per_model.setdefault(name, []).append(row)
+        merged = {name: merge_prefix_cache_stats(rows)
+                  for name, rows in per_model.items()}
+        return {"enabled": bool(merged), "models": merged}
+
+    def prefix_cache_flush(self) -> int:
+        return sum(mem.engine.prefix_cache_flush()
+                   for mem in self.local_members)
+
+    def _count_shed(self, reason: str) -> None:
+        tm.SHED_TOTAL.labels(reason=reason).inc()
+        self.shed_counts[reason] = self.shed_counts.get(reason, 0) + 1
+
+    def retry_after_s(self) -> float:
+        """Fleet-wide Retry-After for shed responses: queue depth over
+        the completion rate OBSERVED AT THE ROUTER — every member's
+        finishes land in the router tracer's window, so the estimate
+        tracks the whole fleet's drain rate (and degrades honestly when
+        a replica is ejected) instead of one member's share overstating
+        the wait."""
+        queued = max(1, self.core.total_queued())
+        window = self.tracer.finish_times
+        if window and len(window) >= 2:
+            span = window[-1] - window[0]
+            if span > 0:
+                rate = (len(window) - 1) / span
+                return float(min(300.0, max(1.0, queued / rate)))
+        return float(min(10.0, max(2.0, float(queued))))
+
+    # -------------------------------------------------------------- ingress
+    def enqueue_request(self, user: str, ip: str, model: str, family=None,
+                        prompt_tokens=None, sampling=None,
+                        kind: str = "generate",
+                        raw_prompt: str = "") -> Request:
+        """Fleet-wide bounded admission + fair-share enqueue. Mirrors
+        TPUEngine.enqueue_request; the caps apply to the ROUTER queue
+        (members run uncapped — the router already admitted)."""
+        cfg = self.ecfg
+        if cfg.max_queued and self.core.total_queued() >= cfg.max_queued:
+            self._count_shed("queue_full")
+            retry_s = self.retry_after_s()
+            self.journal.record(
+                "shed", user=user, model=model or None, reason="queue_full",
+                queued=self.core.total_queued(), limit=cfg.max_queued,
+                retry_after_s=round(retry_s, 3),
+                n_prompt=len(prompt_tokens or []),
+                max_tokens=getattr(sampling, "max_tokens", None))
+            raise QueueFullError("queue_full", retry_s, cfg.max_queued)
+        if (cfg.max_queued_per_user
+                and self.core.queue_len(user) >= cfg.max_queued_per_user):
+            self._count_shed("user_queue_full")
+            retry_s = self.retry_after_s()
+            self.journal.record(
+                "shed", user=user, model=model or None,
+                reason="user_queue_full", queued=self.core.queue_len(user),
+                limit=cfg.max_queued_per_user,
+                retry_after_s=round(retry_s, 3),
+                n_prompt=len(prompt_tokens or []),
+                max_tokens=getattr(sampling, "max_tokens", None))
+            raise QueueFullError("user_queue_full", retry_s,
+                                 cfg.max_queued_per_user)
+        with self._pending_lock:
+            rid = self.core.enqueue(
+                user, ip, model,
+                family if family is not None else Family.UNKNOWN, kind=kind)
+            req = Request(rid, user, model, prompt_tokens or [], sampling,
+                          kind=kind, raw_prompt=raw_prompt)
+            req.trace = self.tracer.begin(rid, user, model, kind=kind)
+            flight = _Flight(req, ip, family if family is not None
+                             else Family.UNKNOWN)
+            self.pending[rid] = flight
+        self.journal.record(
+            "enqueue", req_id=flight.rid0, user=user, model=model or None,
+            n_prompt=len(flight.prompt_tokens),
+            queued=self.core.total_queued(), kind_req=kind,
+            max_tokens=req.sampling.max_tokens,
+            deadline_ms=getattr(req.sampling, "deadline_ms", 0.0) or None)
+        self.notify()
+        return req
+
+    def cancel(self, req_id: int) -> None:
+        with self._pending_lock:
+            flight = self.pending.get(req_id)
+        if flight is not None:
+            flight.req.cancelled.set()
+            if self.core.cancel(req_id):
+                with self._pending_lock:
+                    self.pending.pop(req_id, None)
+                flight.done = True
+                self.journal.record("finish", req_id=flight.rid0,
+                                    user=flight.user, reason="cancelled")
+                flight.req.finish(FinishReason.CANCELLED)
+            self.notify()
+            return
+        for flight in list(self.flights):
+            if flight.req.req_id == req_id and not flight.done:
+                flight.req.cancelled.set()
+                att, mem = flight.attempt, flight.member
+                if att is not None and mem is not None:
+                    mem.cancel(att)
+                break
+        self.notify()
+
+    # ----------------------------------------------------------- main loop
+    def _loop(self) -> None:
+        while self._running:
+            try:
+                self._loop_once()
+            except Exception:
+                # The router thread must never die: a routing bug would
+                # park every queued stream forever.
+                log.exception("fleet loop iteration failed; continuing")
+                time.sleep(0.1)
+
+    def _loop_once(self) -> None:
+        self.last_tick_at = time.monotonic()
+        self.journal.tick += 1
+        self._probe()
+        self._admit()
+        did_work = self._pump()
+        self._drain_progress()
+        if not did_work:
+            with self._cond:
+                self._cond.wait(timeout=0.02)
+
+    # ------------------------------------------------------------ placement
+    def _load_of(self, mem) -> int:
+        return sum(1 for f in self.flights
+                   if f.member is mem and not f.done)
+
+    def _can_place(self, mem, model: str, kind: str) -> bool:
+        if mem.state != "healthy":
+            return False
+        if mem.router_bounded \
+                and self._load_of(mem) >= self.ecfg.max_slots:
+            return False
+        return mem.can_take(model, kind)
+
+    def _eligible_models(self):
+        gen_ok, emb_ok = [], []
+        for model in self.loaded_models():
+            if any(self._can_place(m, model, "generate")
+                   for m in self.members):
+                gen_ok.append(model)
+            if any(self._can_place(m, model, "embed")
+                   for m in self.members):
+                emb_ok.append(model)
+        return gen_ok, emb_ok
+
+    def _choose_member(self, flight: _Flight):
+        elig = [m for m in self.members
+                if self._can_place(m, flight.model, flight.kind)]
+        if not elig:
+            return None
+        # Never fail BACK to the member that just dropped this stream —
+        # unless it is the only one left.
+        others = [m for m in elig if m.name != flight.failed_from]
+        if others:
+            elig = others
+        if self.placement == "affinity" and flight.kind == "generate" \
+                and flight.prompt_tokens:
+            scored = [(m.affinity_pages(flight.model, flight.prompt_tokens),
+                       m) for m in elig]
+            best = max(s for s, _ in scored)
+            if best >= 1:
+                tm.FLEET_AFFINITY_HITS_TOTAL.inc()
+                elig = [m for s, m in scored if s == best]
+        # Least-loaded; ties rotate after the previous pick (the
+        # reference's last_backend_idx round-robin).
+        best_load = min(self._load_of(m) for m in elig)
+        ties = [m for m in elig if self._load_of(m) == best_load]
+        n = len(self.members)
+        for off in range(1, n + 1):
+            cand = self.members[(self._rr + off) % n]
+            if cand in ties:
+                self._rr = (self._rr + off) % n
+                return cand
+        return ties[0]
+
+    def _admit(self) -> int:
+        placed = 0
+        while True:
+            gen_ok, emb_ok = self._eligible_models()
+            if not gen_ok and not emb_ok:
+                break
+            try:
+                item = self.core.next(eligible_models=gen_ok,
+                                      eligible_embed=emb_ok)
+            except StuckQueue:
+                now = time.monotonic()
+                if now - self._last_stuck_log > 10.0:
+                    self._last_stuck_log = now
+                    log.warning(
+                        "fleet pick needs a model no healthy replica "
+                        "serves (ready: %s; %d queued)", gen_ok,
+                        self.core.total_queued())
+                break
+            if item is None:
+                break
+            rid, user, model = item
+            with self._pending_lock:
+                flight = self.pending.pop(rid, None)
+            if flight is None:
+                continue
+            self.journal.record("admit", req_id=flight.rid0, user=user,
+                                model=model or None,
+                                queued=self.core.total_queued())
+            flight.req.trace_event("admit")
+            if flight.req.cancelled.is_set() \
+                    or self.core.is_user_or_ip_blocked(user):
+                self._finish(flight, FinishReason.CANCELLED)
+                continue
+            if flight.req.expired():
+                self._expire(flight)
+                continue
+            mem = self._choose_member(flight)
+            if mem is None:
+                # Capacity raced away between the gate and the pick:
+                # wait-in-queue, FIFO preserved.
+                self._requeue(flight, why="unplaceable")
+                break
+            if self._dispatch(flight, mem):
+                placed += 1
+        return placed
+
+    def _dispatch(self, flight: _Flight, mem) -> bool:
+        try:
+            attempt = mem.begin(flight, flight.resume, on_item=self.notify)
+        except Exception as e:  # noqa: BLE001
+            log.exception("dispatch of req %d to %s failed",
+                          flight.rid0, mem.name)
+            flight.begin_failures += 1
+            if flight.begin_failures > 2:
+                self._finish(flight, FinishReason.ERROR,
+                             error=f"fleet dispatch failed: {e}")
+            else:
+                self._requeue(flight, why="dispatch_failed")
+            return False
+        flight.member = mem
+        flight.attempt = attempt
+        if flight not in self.flights:
+            # A failover re-dispatch happens while the flight is still in
+            # the list; a fresh placement appends it.
+            self.flights.append(flight)
+        replayed = flight.resume.get("n_gen", 0) if flight.resume else 0
+        flight.resume = None
+        if flight.failed_from is not None:
+            self.failover_count += 1
+            tm.FLEET_FAILOVERS_TOTAL.inc()
+            self.journal.record(
+                "replica_failover", req_id=flight.rid0, user=flight.user,
+                model=flight.model or None, replica=flight.failed_from,
+                to_replica=mem.name, replayed_tokens=replayed)
+            log.warning("req %d failed over %s -> %s (%d token(s) replayed)",
+                        flight.rid0, flight.failed_from, mem.name, replayed)
+            flight.failed_from = None
+        self.journal.record("place", req_id=flight.rid0, user=flight.user,
+                            model=flight.model or None, runtime=mem.name)
+        flight.req.trace_event("place", runtime=mem.name)
+        if not flight.req.started:
+            self.core.mark_started(flight.user)
+            flight.req.started = True
+        return True
+
+    def _requeue(self, flight: _Flight, why: str) -> None:
+        try:
+            with self._pending_lock:
+                rid = self.core.requeue_front(flight.user, "", flight.model,
+                                              flight.family,
+                                              kind=flight.kind)
+                flight.req.req_id = rid
+                self.pending[rid] = flight
+            flight.req.trace_event("requeue")
+            self.journal.record("requeue", req_id=flight.rid0,
+                                user=flight.user, why=why)
+        except BlockedError:
+            self._finish(flight, FinishReason.CANCELLED)
+
+    # --------------------------------------------------------------- pumping
+    def _pump(self) -> bool:
+        did = False
+        for flight in list(self.flights):
+            if flight.done:
+                continue
+            if flight.req.cancelled.is_set():
+                self._cancel_flight(flight)
+                did = True
+                continue
+            if flight.evac_since is not None:
+                if self._evac_step(flight):
+                    did = True
+                continue
+            if self._forward(flight):
+                did = True
+        if any(f.done or f.member is None for f in self.flights):
+            self.flights = [f for f in self.flights
+                            if not f.done and f.member is not None]
+        return did
+
+    def _forward(self, flight: _Flight) -> bool:
+        att = flight.attempt
+        if flight.req.stream.overflowed:
+            # Consumer stopped draining and the client stream filled: the
+            # engine-side convention is client-gone (dispatcher.rs's
+            # failed channel send) — cancel rather than buffer forever.
+            flight.req.cancelled.set()
+            self._cancel_flight(flight)
+            return True
+        did = False
+        while (item := att.req.stream.get_nowait()) is not None:
+            did = True
+            if item.kind == "token":
+                self._forward_token(flight, item)
+            else:
+                self._finish_from_item(flight, item)
+                return True
+        if att.transport_dead and flight.evac_since is None:
+            # The member's HTTP stream died under this one request while
+            # the member itself still looks healthy: fail over just this
+            # stream.
+            self._begin_evac(flight)
+            did = True
+        return did
+
+    def _forward_token(self, flight: _Flight, item) -> None:
+        if not item.text:
+            return
+        if not flight.req.stats.first_token_at:
+            flight.req.stats.first_token_at = time.monotonic()
+            flight.req.trace_event(
+                "first_token", ttft_ms=round(flight.req.stats.ttft_ms, 3))
+        flight.req.stream.push(item)
+
+    def _finish_from_item(self, flight: _Flight, item) -> None:
+        reason = item.finish_reason or (
+            FinishReason.ERROR if item.kind == "error" else FinishReason.STOP)
+        tokens = flight.attempt.tokens_done()
+        if flight.kind == "embed":
+            flight.req.embedding = flight.attempt.embedding()
+        flight.req.stats.completion_tokens = tokens
+        self._finish(flight, reason, error=item.error, tokens=tokens)
+
+    def _finish(self, flight: _Flight, reason: FinishReason,
+                error: str = "", tokens: int = 0) -> None:
+        if flight.done:
+            return
+        flight.done = True
+        if reason in (FinishReason.STOP, FinishReason.LENGTH):
+            self.core.mark_done(flight.user, tokens=tokens)
+        else:
+            self.core.mark_dropped(flight.user, started=flight.req.started)
+        self.journal.record("finish", req_id=flight.rid0, user=flight.user,
+                            model=flight.model or None, reason=reason.value,
+                            tokens=tokens)
+        flight.req.finish(reason, error=error)
+
+    def _expire(self, flight: _Flight) -> None:
+        slack_ms = 0.0
+        if flight.req.deadline is not None:
+            slack_ms = (time.monotonic() - flight.req.deadline) * 1e3
+        tm.DEADLINE_DROPS_TOTAL.labels(model=flight.model or "?").inc()
+        self._count_shed("deadline")
+        self.journal.record("deadline_drop", req_id=flight.rid0,
+                            user=flight.user, model=flight.model or None,
+                            slack_ms=round(slack_ms, 1))
+        flight.done = True
+        self.core.mark_dropped(flight.user, started=flight.req.started)
+        flight.req.finish(
+            FinishReason.DEADLINE,
+            error=f"deadline expired {slack_ms:.0f}ms ago (fleet re-dispatch)")
+
+    def _cancel_flight(self, flight: _Flight) -> None:
+        att, mem = flight.attempt, flight.member
+        if att is not None and mem is not None and not att.closed:
+            mem.cancel(att)
+        self._finish(flight, FinishReason.CANCELLED)
+
+    # -------------------------------------------------------------- failover
+    def _begin_evac(self, flight: _Flight) -> None:
+        now = time.monotonic()
+        flight.evac_since = now
+        flight.evac_deadline = now + self.evac_grace_s
+        if flight.attempt is not None and flight.member is not None:
+            flight.member.cancel(flight.attempt)
+
+    def _evac_step(self, flight: _Flight) -> bool:
+        """One evacuation tick: keep forwarding whatever valid output the
+        dying member produced, then — once the member acked the eviction,
+        its loop/reader is dead, or the grace expired — replay the stream
+        (prompt + every emitted token) on a healthy replica."""
+        att = flight.attempt
+        did = False
+        while (item := att.req.stream.get_nowait()) is not None:
+            did = True
+            if item.kind == "token":
+                self._forward_token(flight, item)
+                continue
+            if item.finish_reason == FinishReason.CANCELLED:
+                att.acked = True  # our eviction bounced back, as designed
+            else:
+                # A genuine terminal raced the eviction: the stream is
+                # complete — deliver it, nothing to fail over.
+                self._finish_from_item(flight, item)
+                return True
+        mem = flight.member
+        ready = (att.acked or not mem.alive() or att.reader_dead()
+                 or time.monotonic() >= flight.evac_deadline)
+        if not ready:
+            return did
+        if flight.req.cancelled.is_set():
+            self._finish(flight, FinishReason.CANCELLED)
+            return True
+        if flight.req.expired():
+            self._expire(flight)
+            return True
+        flight.resume = att.resume_state()
+        flight.failed_from = mem.name
+        flight.evac_since = None
+        flight.member = None
+        flight.attempt = None
+        target = self._choose_member(flight)
+        if target is not None:
+            self._dispatch(flight, target)
+        else:
+            # No healthy capacity right now: back to the FRONT of the
+            # router queue; the replica_failover record lands when the
+            # stream is re-placed.
+            self._requeue(flight, why="replica_down")
+        return True
+
+    # --------------------------------------------------------------- health
+    def _probe(self) -> None:
+        now = time.monotonic()
+        if now - self._last_probe < self.probe_period_s:
+            return
+        self._last_probe = now
+        for mem in self.members:
+            plan_holds_down = self._draw_faults(mem)
+            if mem.state == "healthy":
+                age = mem.heartbeat_age()
+                fatal = mem.fatal_alerts()
+                if not mem.alive():
+                    self._eject(mem, "crash", age)
+                elif age > self.eject_heartbeat_s:
+                    self._eject(mem, "stale_heartbeat", age)
+                elif fatal:
+                    self._eject(mem, f"alert:{fatal[0]}", age)
+            elif mem.state == "ejected" and now >= mem.next_probe_at:
+                self._reprobe(mem, plan_holds_down)
+            self._mirror_alerts(mem)
+        self._update_gauges()
+
+    def _draw_faults(self, mem) -> bool:
+        """Evaluate the "replica" fault site for this member's probe slot
+        (members are probed in order, so the per-site call counter
+        indexes (sweep, member) deterministically). Returns True while a
+        device_loss rule holds this member down."""
+        if self.fault_plan is None:
+            return False
+        try:
+            fired = self.fault_plan.draw("replica")
+        except Exception:  # noqa: BLE001
+            log.exception("fault-plan draw failed")
+            return False
+        holds = False
+        for kind, rule in fired:
+            if kind == "device_loss" and rule is None:
+                # A previously drawn device_loss is still unhealed.
+                holds = mem.name in self._plan_down
+            elif kind == "device_loss":
+                self._plan_down.add(mem.name)
+                mem.crash()
+                holds = True
+            elif kind == "exception":
+                mem.crash()
+            elif kind == "slow":
+                mem.force_stale(rule.delay_s)
+        return holds
+
+    def _eject(self, mem, why: str, age: float) -> None:
+        victims = [f for f in self.flights
+                   if f.member is mem and not f.done
+                   and f.evac_since is None]
+        mem.state = "ejected"
+        mem.eject_count += 1
+        mem.backoff_s = self.reprobe_backoff_s
+        mem.next_probe_at = time.monotonic() + mem.backoff_s
+        self.journal.record(
+            "replica_eject", replica=mem.name, why=why,
+            victims=len(victims),
+            heartbeat_age_s=round(age, 2) if age != float("inf") else None,
+            backoff_s=mem.backoff_s)
+        log.error("replica %s is now OFFLINE (%s); %d in-flight stream(s) "
+                  "failing over", mem.name, why, len(victims))
+        for flight in victims:
+            self._begin_evac(flight)
+        self._update_gauges()
+
+    def _reprobe(self, mem, plan_holds_down: bool) -> None:
+        now = time.monotonic()
+        if plan_holds_down:
+            ok = False
+        else:
+            if not mem.alive():
+                try:
+                    mem.restart()
+                except Exception:  # noqa: BLE001
+                    log.exception("restart of member %s failed", mem.name)
+            ok = (mem.alive()
+                  and mem.heartbeat_age() <= self.eject_heartbeat_s
+                  and not mem.fatal_alerts())
+        if ok:
+            mem.state = "healthy"
+            mem.backoff_s = self.reprobe_backoff_s
+            self._plan_down.discard(mem.name)
+            self.journal.record("replica_join", replica=mem.name, why="heal")
+            log.warning("replica %s is back ONLINE (healed); rejoining "
+                        "rotation", mem.name)
+        else:
+            mem.backoff_s = min(REPROBE_BACKOFF_MAX_S, mem.backoff_s * 2
+                                or self.reprobe_backoff_s)
+            mem.next_probe_at = now + mem.backoff_s
+
+    def _mirror_alerts(self, mem) -> None:
+        """Surface each member's firing alerts in the router's alert
+        table as `<member>:<alert>` rows, so one /health read shows the
+        whole fleet's degradation picture."""
+        try:
+            current = {name: sev for name, sev in mem.active_alerts()
+                       if name}
+        except Exception:  # noqa: BLE001
+            current = {}
+        prev = self._mirrored.get(mem.name, set())
+        for name, sev in current.items():
+            self.alerts.fire(f"{mem.name}:{name}", sev or "warn",
+                             f"replica {mem.name} alert: {name}",
+                             source="fleet")
+        for name in prev - set(current):
+            self.alerts.resolve(f"{mem.name}:{name}")
+        self._mirrored[mem.name] = set(current)
+
+    def _update_gauges(self) -> None:
+        counts = {"healthy": 0, "ejected": 0, "draining": 0}
+        for mem in self.members:
+            counts[mem.state] = counts.get(mem.state, 0) + 1
+        for state, n in counts.items():
+            tm.FLEET_REPLICAS.labels(state=state).set(n)
+
+    # ---------------------------------------------------------------- drain
+    def _member(self, name: str):
+        for mem in self.members:
+            if mem.name == name:
+                return mem
+        return None
+
+    def drain_replica(self, name: str,
+                      timeout_s: Optional[float] = None) -> dict:
+        """Quiesce one member: no new placements; in-flight streams run
+        to completion (stragglers past the timeout fail over); then
+        hot-restart and rejoin. Callable from any thread (HTTP admin)."""
+        mem = self._member(name)
+        if mem is None:
+            raise KeyError(f"no replica named {name!r} "
+                           f"(members: {[m.name for m in self.members]})")
+        if mem.state == "ejected":
+            raise RuntimeError(
+                f"replica {name} is ejected; drain applies to serving "
+                "replicas (it will rejoin via the health re-probe)")
+        inflight = self._load_of(mem)
+        if mem.state != "draining":
+            now = time.monotonic()
+            mem.state = "draining"
+            mem.drain_started_at = now
+            mem.drain_deadline = now + (timeout_s if timeout_s is not None
+                                        else self.drain_timeout_s)
+            self.journal.record(
+                "replica_drain", replica=mem.name, inflight=inflight,
+                timeout_s=round(mem.drain_deadline - now, 1))
+            log.warning("replica %s draining: %d in-flight stream(s) "
+                        "running to completion, no new placements",
+                        mem.name, inflight)
+            self._update_gauges()
+            self.notify()
+        return {"replica": mem.name, "state": mem.state,
+                "inflight": inflight}
+
+    def _drain_progress(self) -> None:
+        now = time.monotonic()
+        for mem in self.members:
+            if mem.state != "draining":
+                continue
+            active = [f for f in self.flights
+                      if f.member is mem and not f.done]
+            if not active:
+                try:
+                    mem.hot_restart()
+                except Exception:  # noqa: BLE001
+                    log.exception("hot-restart of %s failed", mem.name)
+                mem.state = "healthy"
+                self.journal.record("replica_join", replica=mem.name,
+                                    why="drain_complete")
+                log.warning("replica %s drained: hot-restarted and back "
+                            "in rotation", mem.name)
+                self._update_gauges()
+            elif now > mem.drain_deadline:
+                # Drain timeout: the stragglers fail over rather than
+                # holding the restart hostage — still zero dropped
+                # streams.
+                for flight in active:
+                    if flight.evac_since is None:
+                        self._begin_evac(flight)
+
+    # ----------------------------------------------------------------- stats
+    def fleet_counts(self) -> dict:
+        counts = {"healthy": 0, "ejected": 0, "draining": 0}
+        for mem in self.members:
+            counts[mem.state] = counts.get(mem.state, 0) + 1
+        return counts
+
+    def fleet_status(self) -> dict:
+        rows = []
+        for mem in self.members:
+            age = mem.heartbeat_age()
+            rows.append({
+                "name": mem.name,
+                "kind": mem.kind_label,
+                "state": mem.state,
+                "heartbeat_age_s": (round(age, 3)
+                                    if age != float("inf") else None),
+                "inflight": self._load_of(mem),
+                "ejects": mem.eject_count,
+                "alerts": [n for n, _ in mem.active_alerts()],
+            })
+        return {
+            "placement": self.placement,
+            "drain_timeout_s": self.drain_timeout_s,
+            "replicas": rows,
+            "counts": self.fleet_counts(),
+            "failovers": self.failover_count,
+            "queued": self.core.total_queued(),
+        }
+
+    def stats(self) -> dict:
+        runtime_stats = []
+        for mem in self.local_members:
+            for rt in mem.engine.runtimes.values():
+                row = rt.stats()
+                row["replica"] = mem.name
+                runtime_stats.append(row)
+        chips = self.chip_stats()
+        hbm_used = sum(c["hbm_used"] for c in chips) or sum(
+            r["param_bytes"] + r["kv_bytes"] for r in runtime_stats)
+        hbm_total = sum(c["hbm_total"] for c in chips) or None
+        return {
+            "runtimes": runtime_stats,
+            "chips": chips,
+            "mesh": None,
+            "hbm_used_bytes": hbm_used,
+            "hbm_total_bytes": hbm_total,
+            "uptime_s": round(time.time() - self.started_at, 1),
+            "health": self.health.status() if self.health else None,
+            "queue": self.core.snapshot(),
+            "shed": dict(self.shed_counts),
+            "preemptions": self.preemption_count(),
+            "retries": self.retry_count(),
+            "fleet": self.fleet_status(),
+        }

@@ -137,6 +137,11 @@ class Server:
         r.add_route("GET", "/debug/prefix_cache", self.debug_prefix_cache)
         r.add_route("POST", "/debug/prefix_cache",
                     self.debug_prefix_cache_flush)
+        # Fleet admin (only when the engine IS a fleet router): replica
+        # states + zero-drop draining for rolling restarts.
+        if hasattr(self.engine, "drain_replica"):
+            r.add_route("GET", "/admin/fleet", self.admin_fleet)
+            r.add_route("POST", "/admin/drain/{replica}", self.admin_drain)
         if self.allow_all_routes:
             r.add_route("*", "/{tail:.*}", self.fallback)
         return app
@@ -510,6 +515,8 @@ class Server:
                 bundle[name] = {"error": f"{type(e).__name__}: {e}"}
 
         section("config", lambda: _redact(dataclasses.asdict(eng.ecfg)))
+        if hasattr(eng, "fleet_status"):
+            section("fleet", eng.fleet_status)
         section("env", lambda: _redact({
             k: v for k, v in os.environ.items()
             if k.startswith(("OLLAMAMQ_", "JAX_", "TPU_"))}))
@@ -561,6 +568,38 @@ class Server:
         except Exception as e:
             raise ApiError(500, f"prefix-cache flush failed: {e}")
         return web.json_response({"status": "success", "freed_pages": freed})
+
+    # --------------------------------------------------------- fleet admin
+    async def admin_fleet(self, request: web.Request) -> web.Response:
+        """Fleet status: per-replica state (healthy/ejected/draining),
+        heartbeat age, in-flight streams, firing alerts, plus placement
+        policy and failover counts."""
+        self._ident(request)
+        return web.json_response(self.engine.fleet_status())
+
+    async def admin_drain(self, request: web.Request) -> web.Response:
+        """Quiesce one replica: no new placements, in-flight streams run
+        to completion (stragglers past the drain timeout fail over),
+        then hot-restart and rejoin — a rolling restart drops nothing.
+        Poll GET /admin/fleet until the replica is healthy again."""
+        self._ident(request)
+        name = request.match_info["replica"]
+        body = await self._body_json(request)
+        timeout_s = None
+        if "timeout_s" in body:
+            try:
+                timeout_s = float(body["timeout_s"])
+            except (TypeError, ValueError):
+                raise ApiError(400, "'timeout_s' must be a number")
+            if timeout_s <= 0:
+                raise ApiError(400, "'timeout_s' must be > 0")
+        try:
+            out = self.engine.drain_replica(name, timeout_s=timeout_s)
+        except KeyError as e:
+            raise ApiError(404, str(e.args[0]) if e.args else str(e))
+        except RuntimeError as e:
+            raise ApiError(409, str(e))
+        return web.json_response({"status": "success", **out})
 
     async def debug_profile(self, request: web.Request) -> web.Response:
         """Capture a jax.profiler trace of the live engine for N seconds

@@ -64,6 +64,12 @@ EVENTS = (
     "page_evict",     # cached prefix pages reclaimed under pressure
     "broadcast",      # SPMD primary shipped a step plan to worker hosts
     "rebuild",        # failed runtime replaced (weights reloaded)
+    # Fleet router (fleet/router.py): dispatcher-over-engines decisions.
+    "replica_eject",     # replica removed from rotation (health-driven)
+    "replica_failover",  # victim stream re-dispatched to another replica
+    "replica_drain",     # replica quiesced: no new placements, in-flight
+    #                      streams run to completion
+    "replica_join",      # replica (re)entered rotation, by reason
 )
 
 # kind -> (required fields, optional fields) beyond the common header
@@ -111,6 +117,15 @@ EVENT_FIELDS: Dict[str, Tuple[tuple, tuple]] = {
     "page_evict": (("n", "free", "used", "cached", "pool"), ()),
     "broadcast": (("op",), ("wire_seq",)),
     "rebuild": ((), ()),
+    # Fleet records carry the replica name plus the inputs that justified
+    # the decision: why a member was ejected (and how stale its heartbeat
+    # was), where a victim stream went and how many tokens its replay
+    # carried, how much in-flight work a drain waited out.
+    "replica_eject": (("replica", "why"),
+                      ("victims", "heartbeat_age_s", "backoff_s")),
+    "replica_failover": (("replica",), ("to_replica", "replayed_tokens")),
+    "replica_drain": (("replica",), ("inflight", "timeout_s")),
+    "replica_join": (("replica",), ("why",)),
 }
 assert set(EVENT_FIELDS) == set(EVENTS)
 
@@ -124,7 +139,8 @@ _FIELD_SETS = {k: (frozenset(req), frozenset(req) | frozenset(opt))
 # scheduler-visible is in.
 DECISION_KINDS = ("enqueue", "admit", "place", "shed", "batch", "install",
                   "preempt", "requeue", "retry", "poison", "deadline_drop",
-                  "finish")
+                  "finish", "replica_eject", "replica_failover",
+                  "replica_drain", "replica_join")
 
 # Per-kind fields folded into the replay signature (deterministic given
 # the same arrivals; excludes timestamps, latencies, and page ids).
@@ -404,6 +420,25 @@ def explain(rec: dict) -> str:
                 f"(wire seq {rec.get('wire_seq', '?')})")
     if kind == "rebuild":
         return f"runtime {rec.get('model', '?')} rebuilt (weights reloaded)"
+    if kind == "replica_eject":
+        s = (f"replica {rec.get('replica', '?')} ejected "
+             f"({rec.get('why', '?')})")
+        if rec.get("victims"):
+            s += f", {rec['victims']} in-flight stream(s) to fail over"
+        if rec.get("heartbeat_age_s") is not None:
+            s += f", heartbeat {rec['heartbeat_age_s']:.1f}s stale"
+        return s
+    if kind == "replica_failover":
+        return (f"{who} failed over from replica {rec.get('replica', '?')}"
+                f" to {rec.get('to_replica', '?')}, replaying "
+                f"{rec.get('replayed_tokens', 0)} already-emitted token(s)")
+    if kind == "replica_drain":
+        return (f"replica {rec.get('replica', '?')} draining: "
+                f"{rec.get('inflight', 0)} in-flight stream(s) running to "
+                "completion, no new placements")
+    if kind == "replica_join":
+        return (f"replica {rec.get('replica', '?')} joined rotation "
+                f"({rec.get('why', 'start')})")
     return f"{kind} {who}"
 
 

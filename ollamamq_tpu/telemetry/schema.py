@@ -179,7 +179,7 @@ SLO_ALERTS_FIRING = REGISTRY.gauge(
 WATCHDOG_STALLS_TOTAL = REGISTRY.counter(
     "ollamamq_watchdog_stalls_total",
     "Stall watchdog firings by kind (engine_step, request_phase, "
-    "worker_host, device)", labels=("kind",))
+    "worker_host, device, replica)", labels=("kind",))
 
 # -- decision journal (telemetry/journal.py; GET /debug/journal) -----------
 JOURNAL_EVENTS_TOTAL = REGISTRY.counter(
@@ -205,6 +205,22 @@ QUANT_LOGIT_ERR = REGISTRY.gauge(
     "bf16 source on the guardrail probe (teacher-forced greedy rollout; "
     "set when the guardrail runs — tests, bench density scenario)",
     labels=("model",))
+
+# -- fleet router (fleet/router.py; dispatcher-over-engines) ---------------
+FLEET_REPLICAS = REGISTRY.gauge(
+    "ollamamq_fleet_replicas",
+    "Engine replicas under the fleet router by state (healthy / ejected "
+    "/ draining); absent when serving single-engine", labels=("state",))
+FLEET_FAILOVERS_TOTAL = REGISTRY.counter(
+    "ollamamq_fleet_failovers_total",
+    "In-flight streams re-dispatched to another replica after their "
+    "replica died or was ejected (each replays prompt + already-emitted "
+    "tokens so the client sees one seamless stream)")
+FLEET_AFFINITY_HITS_TOTAL = REGISTRY.counter(
+    "ollamamq_fleet_placement_affinity_hits_total",
+    "Placements routed to the replica whose prefix-cache radix tree "
+    "already held the prompt's prefix (--placement=affinity); misses "
+    "fall back to least-loaded")
 
 # -- host / device ---------------------------------------------------------
 HBM_USED_BYTES = REGISTRY.gauge(

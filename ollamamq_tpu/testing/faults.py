@@ -28,8 +28,13 @@ Each rule names ONE site and ONE trigger:
            "sp_prefill", "ragged" for the mixed-batch dispatch,
            "spec_verify" for a mixed dispatch carrying speculative
            verify spans, "decode", "embed", "encode", "step" for the
-           fake runtime) or an allocation seam ("alloc" = admission
-           page alloc, "extend" = decode-time page growth).
+           fake runtime), an allocation seam ("alloc" = admission
+           page alloc, "extend" = decode-time page growth), or the
+           fleet router's member-probe seam ("replica": the router
+           probes members in order each health sweep, so the per-site
+           call counter indexes (sweep, member) — "exception" crashes
+           the probed member, "slow" forces its heartbeat stale for
+           delay_s, "device_loss" keeps it down until heal_after_s).
   kind     "exception"  -> the dispatch raises FaultInjected (the
                            engine's retry/containment path handles it);
            "slow"       -> the dispatch sleeps delay_s first (stall
@@ -65,7 +70,7 @@ import time
 from typing import Dict, List, Optional
 
 SITES = ("prefill", "chunk", "sp_prefill", "ragged", "spec_verify",
-         "decode", "embed", "encode", "step", "alloc", "extend")
+         "decode", "embed", "encode", "step", "alloc", "extend", "replica")
 KINDS = ("exception", "slow", "alloc_fail", "device_loss")
 
 _RULE_KEYS = {"site", "kind", "at", "every", "p", "times", "delay_s",
@@ -256,6 +261,28 @@ class FaultPlan:
             elif r.kind == "exception":
                 raise FaultInjected(r.error)
             # alloc_fail rules on a dispatch site are inert by design.
+
+    def draw(self, site: str) -> List[tuple]:
+        """Observer-style hook for sites whose faults the CALLER enacts
+        (the fleet router's "replica" site: it turns "exception" into a
+        member crash and "slow" into a stale-heartbeat window instead of
+        raising/sleeping in its own probe loop). Returns the fired
+        (kind, rule) pairs for this call; device_loss persistence is
+        honored — while a previously drawn device_loss is unhealed, every
+        draw reports a synthetic ("device_loss", None) marker."""
+        dead = self._dead_until
+        if dead is not None:
+            if time.monotonic() < dead:
+                return [("device_loss", None)]
+            self._dead_until = None  # healed
+        out = []
+        for r in self._matching(site):
+            if r.kind == "device_loss":
+                self._dead_until = (
+                    time.monotonic() + r.heal_after_s
+                    if r.heal_after_s is not None else float("inf"))
+            out.append((r.kind, r))
+        return out
 
     def blocked(self, site: str) -> bool:
         """Allocation-seam hook: True when an alloc_fail rule fires (the

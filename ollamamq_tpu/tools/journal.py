@@ -8,7 +8,10 @@ by `record`):
     tail FILE      raw records (filters: --n/--req-id/--user/--kind)
     explain FILE   per-decision human explanations (same filters)
     stats FILE     batch occupancy + padding-waste + fair-share audit
-    check FILE     invariant checker (exit 1 on any violation)
+    check FILE     invariant checker (exit 1 on any violation); fleet
+                   journals additionally pin zero-drop: every stream a
+                   replica_eject/replica_failover touched must reach a
+                   terminal record (check_no_dropped_streams)
 
 Record/replay (the determinism acceptance loop):
 
@@ -54,6 +57,35 @@ _SCENARIO_ENGINE = {"max_slots": 4, "max_queued": 6,
 _SCENARIO_FAULTS = {"seed": 0, "faults": [
     {"site": "step", "kind": "exception", "every": 7, "times": 4},
 ]}
+
+
+def check_no_dropped_streams(records: List[dict]) -> List[str]:
+    """Fleet zero-drop invariant (end-of-run semantics): every stream a
+    replica failure touched must reach a terminal record. The fleet
+    router journals under each stream's ORIGINAL router request id —
+    stable across failovers and requeues — so the audit is a straight
+    pairing: a `replica_failover` (or a `replica_eject` with victims)
+    whose req never reaches finish / shed / deadline_drop / poison by
+    the end of the journal is a dropped stream.
+
+    Run this on COMPLETE journals (a finished bench/chaos run, a drained
+    spill) — a live ring mid-failover would report in-flight streams as
+    violations, which is why this lives here and not in the health
+    monitor's live invariant sweep."""
+    pending: dict = {}  # rid -> seq of the last failover that touched it
+    terminal = ("finish", "shed", "deadline_drop", "poison")
+    for r in records:
+        kind = r.get("kind")
+        rid = r.get("req_id")
+        if kind == "replica_failover" and rid is not None:
+            pending[rid] = r.get("seq", "?")
+        elif kind in terminal and rid is not None:
+            pending.pop(rid, None)
+    return [
+        f"req {rid} stream DROPPED: replica_failover at seq {seq} with no "
+        "terminal record (finish/shed/deadline_drop/poison) by journal end"
+        for rid, seq in sorted(pending.items())
+    ]
 
 
 def _gen_arrivals(seed: int, n: int) -> List[dict]:
@@ -230,6 +262,10 @@ def _cmd_stats(args) -> int:
 def _cmd_check(args) -> int:
     _meta, records = load_jsonl(args.file)
     bad = check_invariants(records)
+    # Fleet runs additionally pin zero-drop: only meaningful when the
+    # journal saw fleet events at all (single-engine journals skip it).
+    if any(r.get("kind", "").startswith("replica_") for r in records):
+        bad = bad + check_no_dropped_streams(records)
     if bad:
         print(f"{len(bad)} invariant violation(s):", file=sys.stderr)
         for b in bad:
@@ -237,7 +273,7 @@ def _cmd_check(args) -> int:
         return 1
     print(f"ok: {len(records)} records, all invariants hold "
           "(pages conserved, no slot double-assignment, victim never VIP, "
-          "sheds only over bounds, no starvation)")
+          "sheds only over bounds, no starvation, no dropped streams)")
     return 0
 
 

@@ -95,6 +95,12 @@ _MINIMAL = {
     "page_evict": dict(n=1, free=13, used=18, cached=0, pool=31),
     "broadcast": dict(op="decode", wire_seq=5),
     "rebuild": dict(),
+    "replica_eject": dict(replica="r1", why="stale_heartbeat", victims=2,
+                          heartbeat_age_s=4.0, backoff_s=0.5),
+    "replica_failover": dict(replica="r1", to_replica="r0",
+                             replayed_tokens=3),
+    "replica_drain": dict(replica="r0", inflight=2, timeout_s=30.0),
+    "replica_join": dict(replica="r1", why="heal"),
 }
 
 
@@ -106,13 +112,13 @@ def test_every_kind_records_and_explains():
         text = explain(rec)
         assert isinstance(text, str) and text
     assert j.seq == len(EVENTS)
-    # The TUI line tracks the newest DECISION kind ("finish" is the last
-    # one in the vocabulary walk above); page/broadcast/rebuild
-    # bookkeeping must not displace it.
-    assert "finished" in j.last_summary()
+    # The TUI line tracks the newest DECISION kind (the fleet
+    # replica_join is the last one in the vocabulary walk above);
+    # page/broadcast/rebuild bookkeeping must not displace it.
+    assert "joined rotation" in j.last_summary()
     j.record("page_alloc", model="m", n=1, free=9, used=21, cached=1,
              pool=31)
-    assert "finished" in j.last_summary()
+    assert "joined rotation" in j.last_summary()
 
 
 def test_tail_filters():
