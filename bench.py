@@ -54,7 +54,7 @@ def _fallback_argv(model: str, dtypes=("bfloat16", "bfloat16"),
            "--shared-prefix", "2", "--shared-prefix-len", "64",
            "--shared-prefix-tail", "16",
            "--slo-burst", "2", "--slo-burst-size", "4",
-           "--overload", "16", "--density", "8",
+           "--overload", "16", "--density", "8", "--scheduling", "16",
            "--init-timeout", "300"]
 
 
@@ -201,6 +201,23 @@ def main() -> int:
                         "generation regime + accept-rate/throttle readout "
                         "on a non-repetitive one; reports byte-identity "
                         "and rollback counts); 0 disables")
+    p.add_argument("--scheduler", choices=("fcfs", "srpt", "edf"),
+                   default="fcfs",
+                   help="scheduling policy of the engine config under "
+                        "test (fcfs = legacy FIFO-within-fair-share; "
+                        "srpt = shortest-predicted-remaining-first; edf "
+                        "= earliest-deadline-first); every BENCH record "
+                        "carries this field next to 'attention'/'spec'/"
+                        "'*_dtype' so A/B rounds are attributable")
+    p.add_argument("--scheduling", type=int, default=32,
+                   help="requests in the scheduling scenario: a bimodal "
+                        "trace (a few long batch requests parked ahead "
+                        "of many short interactive ones) run at the "
+                        "same seed under fcfs and srpt, reporting "
+                        "p50/p99 TTFT per leg with a pass gate (srpt "
+                        "p99 TTFT <= fcfs) and the journal invariant + "
+                        "zero-silent-truncation checks in-band; "
+                        "0 disables")
     p.add_argument("--sampled", action="store_true",
                    help="use Ollama-default sampling (temp 0.8, repeat 1.1) "
                         "instead of greedy — exercises the full sampler")
@@ -331,7 +348,8 @@ def main() -> int:
                 _emit_error(msg, phase=phase, attention="ragged",
                             weights_dtype=args.weights_dtype,
                             kv_dtype=args.kv_dtype,
-                            spec=args.spec, **extras)
+                            spec=args.spec, scheduler=args.scheduler,
+                            **extras)
                 os._exit(exit_code)
 
         threading.Thread(target=w, daemon=True).start()
@@ -350,7 +368,8 @@ def main() -> int:
             return 3
         _emit_error(msg, phase="init", attention="ragged",
                     weights_dtype=args.weights_dtype,
-                    kv_dtype=args.kv_dtype, spec=args.spec)
+                    kv_dtype=args.kv_dtype, spec=args.spec,
+                    scheduler=args.scheduler)
         return 3
     # Pages: prompt + generated headroom for every slot. A leg consumes,
     # beyond prompt + steps: one compile dispatch (chunk), timed_decode's
@@ -378,6 +397,7 @@ def main() -> int:
         token_granule=args.token_granule,
         spec=args.spec,
         spec_k=args.spec_k,
+        scheduler=args.scheduler,
         weights_dtype=args.weights_dtype,
         kv_dtype=args.kv_dtype,
     )
@@ -385,13 +405,19 @@ def main() -> int:
     t0 = time.monotonic()
     try:
         rt = ModelRuntime(args.model, model_cfg, ecfg)
+        from ollamamq_tpu.engine.scheduler import make_policy
+
+        # Scheduling-policy seam, attached like the engine does in
+        # _attach_hooks (bench drives the runtime directly).
+        rt.policy = make_policy(ecfg)
     except Exception as e:
         msg = f"runtime init failed: {type(e).__name__}: {e}"
         if _any_fallback(args.model, msg, _dtypes):
             return 4
         _emit_error(msg, phase="runtime_init", device=str(dev),
                     attention="ragged", weights_dtype=args.weights_dtype,
-                    kv_dtype=args.kv_dtype, spec=args.spec)
+                    kv_dtype=args.kv_dtype, spec=args.spec,
+                    scheduler=args.scheduler)
         return 4
     finally:
         init_done.set()  # watchdog covers device + runtime init, not the run
@@ -727,6 +753,21 @@ def main() -> int:
             print(f"# slo_burst scenario failed: {slo_burst['error']}",
                   file=sys.stderr)
 
+    # scheduling scenario: the SAME bimodal arrival trace (long batch
+    # requests parked ahead of a burst of short interactive ones) under
+    # --scheduler=fcfs and --scheduler=srpt on identically shaped tiny
+    # runtimes — p50/p99 TTFT per leg, the srpt-must-not-lose pass gate,
+    # and journal invariants (incl. the anti-starvation bound) +
+    # zero-silent-truncation checks in-band.
+    scheduling = None
+    if args.scheduling > 0:
+        try:
+            scheduling = _scheduling_scenario(args, touch)
+        except Exception as e:  # never discard the decode numbers
+            scheduling = {"error": f"{type(e).__name__}: {e}"}
+            print(f"# scheduling scenario failed: {scheduling['error']}",
+                  file=sys.stderr)
+
     # fleet scenario: kill-and-drain chaos through the fleet router at
     # ~10x the overload request count — a seeded replica-kill fault plan
     # plus a mid-run POST /admin/drain, with the zero-drop contract
@@ -762,6 +803,9 @@ def main() -> int:
         # Speculative decoding on/off in the engine config under test;
         # the `speculative` scenario below reports its own A/B legs.
         "spec": bool(args.spec),
+        # Scheduling policy of the config under test; the `scheduling`
+        # scenario below reports its own fcfs-vs-srpt legs.
+        "scheduler": args.scheduler,
         "telemetry": telemetry,
         "hbm_gbps_est": round(hbm_gbps, 1),
         "mfu_pct_est": round(mfu_pct, 2),
@@ -799,6 +843,8 @@ def main() -> int:
         result["overload"] = overload
     if density is not None:
         result["density"] = density
+    if scheduling is not None:
+        result["scheduling"] = scheduling
     if fleet is not None:
         result["fleet"] = fleet
     run_done.set()
@@ -814,6 +860,121 @@ def _pump(rt, core, touch, phase):
     progressed = rt.step_ragged(core)
     touch(phase)
     return progressed
+
+
+def _scheduling_scenario(args, touch):
+    """Size-aware scheduling A/B: the SAME bimodal trace — a few long
+    batch requests enqueued ahead of many short interactive ones, over a
+    2-slot runtime — runs under fcfs and srpt on identically shaped
+    test-tiny runtimes (same prompt seed, eos disabled so every stream
+    runs exactly max_tokens). The readout is p50/p99 TTFT per leg; the
+    pass gate is srpt p99 TTFT <= fcfs with 0 journal invariant
+    violations (the anti-starvation bound included) and 0 silent
+    truncations — ordering must only ever change timing, never tokens."""
+    import time
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ollamamq_tpu.config import MODEL_CONFIGS, EngineConfig
+    from ollamamq_tpu.core.mqcore import MQCore
+    from ollamamq_tpu.engine.engine import ModelRuntime, drop_expired
+    from ollamamq_tpu.engine.request import Request
+    from ollamamq_tpu.engine.scheduler import make_policy
+    from ollamamq_tpu.ops.sampling import SamplingParams
+    from ollamamq_tpu.telemetry.journal import Journal, check_invariants
+
+    n_total = max(8, args.scheduling)
+    n_long = max(1, n_total // 8)
+    long_new, short_new = 48, 4
+    long_prompt, short_prompt = 48, 8
+    # Longs FIRST: the regime ROADMAP item 4 names — one long output
+    # parked ahead of a burst of short interactive requests.
+    arrivals = [(f"batch{i}", long_prompt, long_new) for i in range(n_long)]
+    arrivals += [(f"chat{i % 8}", short_prompt, short_new)
+                 for i in range(n_total - n_long)]
+
+    def leg(policy_name):
+        ecfg = EngineConfig(
+            model="test-tiny", max_slots=2, num_pages=256, page_size=8,
+            max_pages_per_seq=16, decode_steps_per_iter=2,
+            max_batch_tokens=128, token_granule=8,
+            scheduler=policy_name)
+        rt = ModelRuntime("test-tiny", MODEL_CONFIGS["test-tiny"], ecfg,
+                          dtype=jnp.float32)
+        rt.tokenizer.eos_id = -1  # deterministic full-length streams
+        policy = make_policy(ecfg)
+        rt.policy = policy
+        journal = Journal(capacity=65536)
+        rt.journal = journal
+        core = MQCore(None)
+
+        def requeue(req):
+            if req.expired():
+                drop_expired(req, core, rt.name)
+                return False
+            rt.pending_prefill.appendleft(req)
+            return True
+
+        rt.on_preempt = requeue
+        prompt_rng = np.random.default_rng(1234)  # SAME prompts per leg
+        reqs = []
+        for i, (user, plen, mnew) in enumerate(arrivals):
+            prompt = prompt_rng.integers(
+                3, rt.cfg.vocab_size - 1, size=plen).tolist()
+            req = Request(60000 + i, user, rt.name, prompt,
+                          SamplingParams(max_tokens=mnew))
+            req._inc_decode = rt.tokenizer.make_incremental_decoder()
+            reqs.append(req)
+            rt.pending_prefill.append(req)
+        guard = 0
+        while any(not r.stats.finished_at for r in reqs):
+            policy.on_admit_tick()  # the aging clock, as the engine loop
+            progressed = _pump(rt, core, touch, "scheduling")
+            if any(r is not None for r in rt.slot_req):
+                progressed = (rt.step_decode(core, k_steps=2) > 0) \
+                    or progressed
+            guard += 1
+            if guard > 2000 * n_total:
+                raise RuntimeError("scheduling leg wedged")
+            if not progressed:
+                time.sleep(0.001)
+        ttfts = sorted(r.stats.ttft_ms for r in reqs)
+        # Ordering must never change tokens: every stream runs exactly
+        # its max_tokens (eos disabled), or something truncated silently.
+        silent = sum(1 for r in reqs
+                     if len(r.generated_ids) != r.sampling.max_tokens)
+        recs = journal.tail(None)
+        rt.journal = None
+        return {
+            "scheduler": policy_name,
+            "served": len(ttfts),
+            "ttft_p50_ms": round(ttfts[len(ttfts) // 2], 1),
+            "ttft_p99_ms": round(
+                ttfts[min(len(ttfts) - 1, int(0.99 * len(ttfts)))], 1),
+            "ttft_max_ms": round(ttfts[-1], 1),
+            "invariant_violations": len(check_invariants(recs)),
+            "silent_truncations": silent,
+            "sched_decisions": policy.decisions,
+            "pred_observed": policy.predictor.observed,
+        }
+
+    legs = {name: leg(name) for name in ("fcfs", "srpt")}
+    delta = legs["fcfs"]["ttft_p99_ms"] - legs["srpt"]["ttft_p99_ms"]
+    return {
+        "requests": n_total,
+        "long_requests": n_long,
+        "long_tokens": long_new,
+        "short_tokens": short_new,
+        "legs": legs,
+        "p99_ttft_delta_ms": round(delta, 1),
+        "pass": bool(
+            legs["srpt"]["ttft_p99_ms"] <= legs["fcfs"]["ttft_p99_ms"]
+            and all(leg_["invariant_violations"] == 0
+                    and leg_["silent_truncations"] == 0
+                    for leg_ in legs.values())),
+    }
 
 
 def _fleet_scenario(args, rng, touch):

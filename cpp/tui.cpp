@@ -366,12 +366,33 @@ struct Tui {
     char degrade[48];
     std::snprintf(degrade, sizeof degrade, "shed %.0f  preempt %.0f", shed,
                   preempt);
+    /* Scheduler chip: active policy (fcfs/srpt/edf) + the output-length
+     * predictor's accuracy over its recent window. "acc n/a" until the
+     * predictor has observed enough finishes to warm up. */
+    char schedc[64];
+    auto sched = stats->get("sched");
+    if (sched && sched->type == mj::Value::OBJ) {
+      std::string pol =
+          sched->get("policy") ? sched->get("policy")->as_str() : "?";
+      auto acc = sched->get("pred_accuracy");
+      if (acc && !acc->is_null())
+        std::snprintf(schedc, sizeof schedc, "sched %s acc %.0f%%",
+                      pol.c_str(), acc->as_num() * 100.0);
+      else
+        std::snprintf(schedc, sizeof schedc, "sched %s acc n/a",
+                      pol.c_str());
+    } else {
+      std::snprintf(schedc, sizeof schedc, "sched n/a");
+    }
     if (mfu > 0)
-      std::snprintf(l, sizeof l, " throughput %.0f tok/s   MFU %.2f%%   %s   %s",
-                    tok_rate > 0 ? tok_rate : 0.0, mfu * 100.0, cache, degrade);
+      std::snprintf(l, sizeof l,
+                    " throughput %.0f tok/s   MFU %.2f%%   %s   %s   %s",
+                    tok_rate > 0 ? tok_rate : 0.0, mfu * 100.0, cache, degrade,
+                    schedc);
     else
-      std::snprintf(l, sizeof l, " throughput %.0f tok/s   MFU --   %s   %s",
-                    tok_rate > 0 ? tok_rate : 0.0, cache, degrade);
+      std::snprintf(l, sizeof l,
+                    " throughput %.0f tok/s   MFU --   %s   %s   %s",
+                    tok_rate > 0 ? tok_rate : 0.0, cache, degrade, schedc);
     out.push_back(std::string(CYAN) + l + RST);
     /* Fleet replicas chip (only under a fleet router): N healthy / M
      * ejected / K draining. Red when any member is out of rotation —

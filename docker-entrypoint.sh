@@ -20,6 +20,7 @@ set -- --no-tui --host 0.0.0.0
 [ -n "${REPLICAS:-}" ] && set -- "$@" --replicas "$REPLICAS"
 [ -n "${REPLICA_URLS:-}" ] && set -- "$@" --replica-urls "$REPLICA_URLS"
 [ -n "${PLACEMENT:-}" ] && set -- "$@" --placement "$PLACEMENT"
+[ -n "${SCHEDULER:-}" ] && set -- "$@" --scheduler "$SCHEDULER"
 [ -n "${DRAIN_TIMEOUT_S:-}" ] && set -- "$@" --drain-timeout-s "$DRAIN_TIMEOUT_S"
 [ -n "${MAX_SLOTS:-}" ] && set -- "$@" --max-slots "$MAX_SLOTS"
 [ -n "${BLOCKLIST:-}" ] && set -- "$@" --blocklist "$BLOCKLIST"

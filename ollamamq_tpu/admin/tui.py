@@ -73,6 +73,16 @@ def _engine_stats_brief(engine) -> dict:
     # KV-pressure preemptions across runtimes.
     shed = sum(getattr(engine, "shed_counts", {}).values())
     preempt = sum(m.get("preemptions", 0) or 0 for m in models)
+    # Scheduler chip: active policy + output-length predictor accuracy
+    # ("acc n/a" in the TUI until the predictor warms up). Engines and
+    # the fleet router both expose scheduler_stats().
+    sched = None
+    ss = getattr(engine, "scheduler_stats", None)
+    if ss is not None:
+        try:
+            sched = ss()
+        except Exception:
+            sched = None
     # Flight-recorder last-decision line: the newest scheduler decision
     # (admit/shed/preempt/...) with the inputs that justified it — the
     # operator's at-a-glance "what did the scheduler just do".
@@ -94,6 +104,8 @@ def _engine_stats_brief(engine) -> dict:
         "last_decision": last_decision,
         "alerts": alerts,
     }
+    if sched is not None:
+        out["sched"] = sched
     # Fleet replicas chip (N healthy / M ejected / K draining): present
     # only when the engine is a fleet router.
     fleet = getattr(engine, "fleet_counts", None)

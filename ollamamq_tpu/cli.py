@@ -92,6 +92,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "warmup sample), speculation is disabled for "
                         "that user — wasted verify FLOPs must pay for "
                         "themselves; 0 never throttles")
+    p.add_argument("--scheduler",
+                   default=os.environ.get("SCHEDULER", "fcfs"),
+                   help="scheduling policy: 'fcfs' (default; FIFO within "
+                        "fair share, bit-identical to the pre-policy "
+                        "engine), 'srpt' (shortest-predicted-remaining-"
+                        "first off an online output-length predictor, "
+                        "with anti-starvation aging), or 'edf' "
+                        "(earliest-deadline-first; srpt order for "
+                        "deadline-less requests). Policies reorder only "
+                        "within what fair-share already allows; promote "
+                        "a candidate with `python -m "
+                        "ollamamq_tpu.tools.journal simulate TRACE "
+                        "--scheduler srpt` counterfactual replay")
     p.add_argument("--prefix-cache", action="store_true",
                    help="automatic prefix caching: share finished prompts' "
                         "KV pages (page-granular radix tree) across "
@@ -305,6 +318,15 @@ def main(argv=None) -> int:
         log.error("--journal-rotate-mb / --log-rotate-mb must be >= 0 "
                   "(0 disables rotation)")
         return 2
+    # Scheduler policy fails fast BEFORE any device work — argparse
+    # doesn't validate env-supplied defaults, so a typo'd SCHEDULER env
+    # must die here, not at the first admission pass.
+    from ollamamq_tpu.config import validate_scheduler
+
+    sched_err = validate_scheduler(args.scheduler)
+    if sched_err is not None:
+        log.error("%s", sched_err)
+        return 2
     fleet_urls = [u.strip() for u in args.replica_urls.split(",")
                   if u.strip()]
     if args.replicas < 0 or (args.replicas == 0 and not fleet_urls):
@@ -391,6 +413,7 @@ def main(argv=None) -> int:
         spec=args.spec,
         spec_k=args.spec_k,
         spec_min_accept=args.spec_min_accept,
+        scheduler=args.scheduler,
         prefix_cache=args.prefix_cache,
         prefix_cache_min_pages=args.prefix_cache_min_pages,
         dp=args.dp,

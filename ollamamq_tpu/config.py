@@ -333,6 +333,16 @@ class EngineConfig:
     # POST /admin/drain/{replica}: in-flight streams get this long to
     # complete before the stragglers fail over to healthy replicas.
     drain_timeout_s: float = 30.0
+    # -- scheduling policy (engine/scheduler.py) -----------------------------
+    # Admission / prefill-packing / preemption-victim ordering: "fcfs"
+    # (default; bit-identical to the pre-policy-extraction engine),
+    # "srpt" (shortest-predicted-remaining-first off the online
+    # output-length predictor, with anti-starvation aging), "edf"
+    # (earliest-deadline-first over Request.deadline; srpt order for
+    # deadline-less requests). Policies reorder only within what the
+    # fair-share core already released; promote a candidate via
+    # `tools/journal simulate` counterfactual replay.
+    scheduler: str = "fcfs"
     # -- flight recorder (telemetry/journal.py) ------------------------------
     # Decision-journal ring capacity (records retained for /debug/journal
     # and the health monitor's invariant sweep).
@@ -350,6 +360,20 @@ class EngineConfig:
 
 
 QUANT_DTYPES = ("bfloat16", "int8")
+
+# Closed scheduling-policy vocabulary (engine/scheduler.py maps each
+# name to its implementation and asserts the two stay in sync).
+SCHEDULERS = ("fcfs", "srpt", "edf")
+
+
+def validate_scheduler(name: str) -> Optional[str]:
+    """Fail-fast --scheduler validation BEFORE any device work: returns
+    an error string (None = valid). Shared by the CLI and the deploy
+    plumbing so a typo'd SCHEDULER env kills the process at startup,
+    not at the first admission pass."""
+    if name not in SCHEDULERS:
+        return f"--scheduler must be one of {SCHEDULERS}, got {name!r}"
+    return None
 
 
 def validate_quant_config(weights_dtype: str, kv_dtype: str,

@@ -223,6 +223,39 @@ class MQCore:
             raise StuckQueue()
         return rid, ubuf.value.decode(), mbuf.value.decode()
 
+    def next_window(
+        self, k: int,
+        eligible_models: Optional[Iterable[str]] = None,
+        eligible_embed: Optional[Iterable[str]] = None,
+    ) -> Tuple[list, bool]:
+        """Pop up to k dispatchable tasks in fair-share order — the
+        candidate window a SchedulerPolicy (engine/scheduler.py) may
+        reorder before placement. The native core still decides WHICH
+        tasks are released (per-user fair share, VIP/boost, blocklist,
+        model eligibility); a policy only reorders within the released
+        window, so k=1 is exactly the legacy pop-and-place flow.
+
+        Returns (items, stuck): items is a list of (req_id, user, model)
+        tuples, stuck=True means a later pop hit a policy-selected-but-
+        unservable front (StuckQueue) AFTER the returned items — they
+        were already dequeued and must still be placed."""
+        eligible_models = (list(eligible_models)
+                          if eligible_models is not None else None)
+        eligible_embed = (list(eligible_embed)
+                          if eligible_embed is not None else None)
+        items: list = []
+        stuck = False
+        for _ in range(max(1, int(k))):
+            try:
+                item = self.next(eligible_models, eligible_embed)
+            except StuckQueue:
+                stuck = True
+                break
+            if item is None:
+                break
+            items.append(item)
+        return items, stuck
+
     def cancel(self, req_id: int) -> bool:
         return bool(self._lib.mq_cancel(self._h, req_id))
 

@@ -45,6 +45,7 @@ from ollamamq_tpu.core import MQCore, Fairness, Family
 from ollamamq_tpu.core.mqcore import BlockedError, StuckQueue
 from ollamamq_tpu.engine import kv_cache as kvc
 from ollamamq_tpu.engine.request import FinishReason, Request, StreamItem
+from ollamamq_tpu.engine.scheduler import make_policy
 from ollamamq_tpu.engine.tokenizer import load_tokenizer
 from ollamamq_tpu.models import llama, weights
 from ollamamq_tpu.ops.sampling import (accept_prefix, maybe_apply_penalties,
@@ -282,6 +283,12 @@ class ModelRuntime:
     # engine's _attach_hooks. None on SPMD worker hosts' replay runtimes —
     # journaling, like SLO accounting, is primary-only.
     journal = None
+
+    # Scheduling policy (engine/scheduler.py), attached by the owning
+    # engine's _attach_hooks (bench/tests attach directly). None behaves
+    # exactly like fcfs: identity orderings, legacy victim key, no
+    # output-length prediction.
+    policy = None
 
     def __init__(
         self,
@@ -1187,8 +1194,16 @@ class ModelRuntime:
         req = self.slot_req[slot]
         if req is None:
             return
+        pol = self.policy
+        extra = ({"predicted_tokens": pol.predict(req)}
+                 if pol is not None else {})
         self._jrec("finish", req, reason=reason.value, slot=slot,
-                   tokens=len(req.generated_ids))
+                   tokens=len(req.generated_ids), **extra)
+        if pol is not None and reason in (FinishReason.STOP,
+                                          FinishReason.LENGTH):
+            # Served-to-completion outcomes feed the output-length
+            # predictor; cancels/errors would teach it client behavior.
+            pol.observe_finish(req, model=self.name)
         # Pass req: an installed slot's prompt KV is fully written, so
         # its full prompt pages are insertable into the prefix cache.
         self._release_slot_pages(slot, req)
@@ -1270,6 +1285,10 @@ class ModelRuntime:
         prefill TOGETHER in one forward (up to MAX_PREFILL_BATCH), which
         collapses the cold-start TTFT of a burst of arrivals. Long prompts
         hand off to the incremental chunked path. Returns True if ran."""
+        if self.policy is not None:
+            # Decision point (a): slot-admission order. fcfs/None is a
+            # no-op; srpt/edf stable-sort the released queue in place.
+            self.policy.reorder_pending(self.pending_prefill)
         batch: List[tuple] = []  # (req, slot, pages, n)
         bucket = None
         claimed: set = set()
@@ -1748,12 +1767,15 @@ class ModelRuntime:
                        "--num-pages")
 
     def _pick_victim(self) -> Optional[int]:
-        """Victim slot for a preemption: lowest fair-share priority first
-        (the user with the most lifetime served requests), youngest
-        arrival as tie-break — NEVER the VIP, never a request that spent
-        its preemption budget (anti-livelock: it holds a reservation).
-        Stalled reservation-holders under budget still qualify — they
-        hold pages too. None = nobody is preemptible."""
+        """Victim slot for a preemption. Decision point (c) of the
+        scheduler policy: eligibility stays here — NEVER the VIP, never
+        a request that spent its preemption budget (anti-livelock: it
+        holds a reservation) — while the preference among eligible slots
+        is the policy's victim_key (max wins). fcfs/None keeps the
+        legacy heuristic: lowest fair-share priority first (the user
+        with the most lifetime served requests), youngest arrival as
+        tie-break. Stalled reservation-holders under budget still
+        qualify — they hold pages too. None = nobody is preemptible."""
         vip = None
         users: dict = {}
         try:
@@ -1762,6 +1784,7 @@ class ModelRuntime:
             users = snap.get("users", {})
         except Exception:
             pass  # degraded victim pick (age only) beats no preemption
+        pol = self.policy
         best, best_key = None, None
         for i, r in enumerate(self.slot_req):
             if r is None or r.preemptions >= self.ecfg.preempt_max:
@@ -1769,9 +1792,15 @@ class ModelRuntime:
             if vip is not None and r.user == vip:
                 continue
             served = users.get(r.user, {}).get("processed", 0)
-            key = (served, r.stats.enqueued_at)
+            key = (pol.victim_key(r, served) if pol is not None
+                   else (served, r.stats.enqueued_at))
             if best_key is None or key > best_key:
                 best, best_key = i, key
+        if best is not None and pol is not None and pol.name != "fcfs":
+            victim = self.slot_req[best]
+            self._jrec("sched", victim, policy=pol.name, point="victim",
+                       predicted=pol.predict(victim),
+                       score=round(pol.remaining(victim), 3))
         return best
 
     # Seam for _pick_victim's policy inputs: the engine loop owns `core`
@@ -2027,6 +2056,10 @@ class ModelRuntime:
         by the token budget instead of a bucket. Prefix-cache hits pin
         their shared pages and start the span at the cached boundary.
         Returns True if anything was admitted."""
+        if self.policy is not None:
+            # Decision point (a): slot-admission order out of the
+            # released queue (fcfs/None: untouched FIFO).
+            self.policy.reorder_pending(self.pending_prefill)
         did = False
         largest = self.ecfg.prefill_buckets[-1]
         while self.pending_prefill:
@@ -2208,7 +2241,12 @@ class ModelRuntime:
         fixed_tokens = sum(span for *_, span in rows)
         budget = self._ragged_budget - fixed_tokens
         now = time.monotonic()
-        for req in list(self.chunking):
+        # Decision point (b): prefill-span packing order — which
+        # in-flight prefills the remaining token budget goes to first
+        # (fcfs/None: FIFO, exactly the legacy composition).
+        chunk_order = (self.policy.pack_order(self.chunking)
+                       if self.policy is not None else list(self.chunking))
+        for req in chunk_order:
             if budget <= 0:
                 break
             slot = req._prefill_slot
@@ -3105,6 +3143,12 @@ class TPUEngine:
         dtype=None,
     ):
         self.ecfg = engine_cfg
+        # Scheduling policy (engine/scheduler.py): built BEFORE any
+        # device/model work so an unknown --scheduler fails loudly at
+        # startup. fcfs (the default) is bit-identical to the
+        # pre-extraction engine; srpt/edf reorder admission, prefill
+        # packing, and victim picks within what fairness releases.
+        self.policy = make_policy(engine_cfg)
         self.core = MQCore(blocklist_path)
         self.core.set_fairness(fairness)
         if mesh is None and (engine_cfg.dp, engine_cfg.sp, engine_cfg.tp,
@@ -3219,6 +3263,7 @@ class TPUEngine:
         rep.slo = self.slo
         rep.fault_plan = self.fault_plan
         rep.journal = self.journal
+        rep.policy = self.policy
         if self.ecfg.preempt:
             rep.on_preempt = self._requeue_preempted
 
@@ -3615,6 +3660,12 @@ class TPUEngine:
 
     def _admit(self) -> int:
         admitted = 0
+        pol = self.policy
+        # One batch tick on the scheduler clock — the anti-starvation
+        # aging runs on admission passes, which fire once per engine
+        # loop iteration in the live engine AND once per virtual tick in
+        # the synchronous replay/simulate drivers.
+        pol.on_admit_tick()
         # Retry orphans: ids popped before their Request was registered
         # (two-step submit flow); give them a 5 s grace. Expiry always runs;
         # the capacity gate only defers placement of registered requests.
@@ -3652,6 +3703,32 @@ class TPUEngine:
         for rid, ts in list(self._expired_orphans.items()):
             if now - ts > 60.0:
                 del self._expired_orphans[rid]
+        # Candidate batch: the window of pops the fair-share core
+        # released this pass, placed in POLICY order (decision point
+        # (a)). fcfs has admission_window == 1, so each pop flushes
+        # immediately — the exact legacy pop-and-place flow.
+        batch: List[tuple] = []  # (rid, user, model, req)
+
+        def flush() -> None:
+            nonlocal admitted
+            if not batch:
+                return
+            ordered = pol.order_admission(list(batch))
+            batch.clear()
+            if len(ordered) > 1 and pol.name != "fcfs":
+                first = ordered[0][3]
+                self.journal.record(
+                    "sched", req=first, policy=pol.name, point="admit",
+                    candidates=len(ordered),
+                    predicted=pol.predict(first),
+                    score=round(pol.score(first), 3))
+            for rid, user, model, req in ordered:
+                req.trace_event("admit")
+                self.journal.record("admit", req=req,
+                                    queued=self.core.total_queued())
+                if self._place(req, user, model):
+                    admitted += 1
+
         while True:
             # Two capacity pools, one gate each: the native pop gates an
             # embed task on the embed list and a generate task on the
@@ -3662,10 +3739,21 @@ class TPUEngine:
                       if self._gate_eligible(rt, "embed")]
             if not gen_ok and not emb_ok:
                 break
-            try:
-                item = self.core.next(eligible_models=gen_ok,
-                                      eligible_embed=emb_ok)
-            except StuckQueue:
+            items, stuck = self.core.next_window(
+                pol.admission_window, eligible_models=gen_ok,
+                eligible_embed=emb_ok)
+            for rid, user, model in items:
+                with self._pending_lock:
+                    req = self.pending.pop(rid, None)
+                if req is None:
+                    # Popped before registration (legacy two-step
+                    # submit): park it and retry for a grace period.
+                    self._orphans.append((rid, user, model,
+                                          time.monotonic()))
+                    continue
+                batch.append((rid, user, model, req))
+            flush()
+            if stuck:
                 # Policy pick unservable; cursor advanced, retry on wake.
                 # Rate-limited warn for operator visibility (the reference
                 # logs "Request stuck in queue", dispatcher.rs:467-473).
@@ -3679,21 +3767,8 @@ class TPUEngine:
                         gen_ok, emb_ok, self.core.total_queued(),
                     )
                 break
-            if item is None:
+            if not items:
                 break
-            rid, user, model = item
-            with self._pending_lock:
-                req = self.pending.pop(rid, None)
-            if req is None:
-                # Popped before registration (legacy two-step submit):
-                # park it and retry for a grace period.
-                self._orphans.append((rid, user, model, time.monotonic()))
-                continue
-            req.trace_event("admit")
-            self.journal.record("admit", req=req,
-                                queued=self.core.total_queued())
-            if self._place(req, user, model):
-                admitted += 1
         return admitted
 
     def _place(self, req: Request, user: str, model: str) -> bool:
@@ -4032,6 +4107,18 @@ class TPUEngine:
             log.exception("error while failing runtime %s", rt.name)
 
     # -- prefix cache (GET/POST /debug/prefix_cache) -----------------------
+    def scheduler_stats(self) -> dict:
+        """Live scheduling-policy readout (TUI sched chip, engine stats,
+        /metrics.json): active policy, output-length predictor accuracy
+        over its recent window (None until warmed up — rendered as
+        "acc n/a"), observation count, and reorder decisions applied."""
+        p = self.policy
+        acc = p.predictor.accuracy()
+        return {"policy": p.name,
+                "pred_accuracy": round(acc, 4) if acc is not None else None,
+                "pred_observed": p.predictor.observed,
+                "decisions": p.decisions}
+
     def prefix_cache_stats(self) -> dict:
         """Per-model prefix-cache stats (replicas summed); works on any
         engine subclass — runtimes without a cache are skipped."""
@@ -4113,4 +4200,6 @@ class TPUEngine:
             "shed": dict(self.shed_counts),
             "preemptions": self.preemption_count(),
             "retries": self.retry_count(),
+            # Scheduling policy + output-length predictor accuracy.
+            "scheduler": self.scheduler_stats(),
         }

@@ -30,6 +30,10 @@ class FakeRuntime:
     fault_plan = None  # deterministic fault injection (testing/faults.py)
     on_preempt = None  # attached like ModelRuntime's (unused by fakes)
     journal = None  # decision journal, attached like ModelRuntime's
+    # Scheduling policy (engine/scheduler.py), attached like the
+    # journal — the deterministic seam the replay/simulate harness and
+    # the policy tests drive without jax. None behaves exactly as fcfs.
+    policy = None
 
     def __init__(self, name: str, engine_cfg: EngineConfig,
                  token_latency_s: float = 0.0, is_encoder: bool = False):
@@ -78,6 +82,21 @@ class FakeRuntime:
         if self.journal is not None:
             self.journal.record(kind, req=req, model=self.name, **fields)
 
+    def _finish_served(self, req: Request, core, reason: FinishReason) -> None:
+        """Served-to-completion finish: journal the outcome next to the
+        scheduler's prediction and feed the output-length predictor —
+        same contract as ModelRuntime._finish_slot."""
+        core.mark_done(req.user, tokens=len(req.generated_ids))
+        req.stats.completion_tokens = len(req.generated_ids)
+        pol = self.policy
+        extra = ({"predicted_tokens": pol.predict(req)}
+                 if pol is not None else {})
+        self._jrec("finish", req, reason=reason.value,
+                   tokens=len(req.generated_ids), **extra)
+        if pol is not None:
+            pol.observe_finish(req, model=self.name)
+        req.finish(reason)
+
     def check_cancellations(self, core) -> None:
         for req in list(self.active):
             if req.cancelled.is_set():
@@ -92,12 +111,25 @@ class FakeRuntime:
         # so shedding/retry/watchdog paths are testable without jax.
         if self.fault_plan is not None:
             self.fault_plan.check("step")
-        # Admit everything pending (fake engine has no real slot pressure).
+        # Admission: slot-bounded so scheduling-policy order actually
+        # decides WHO enters a contended batch (pre-policy the pop gate
+        # alone bounded concurrency, so this gate never binds for fcfs
+        # traces — the decision stream is unchanged). Cancelled/expired
+        # heads always drain regardless, and embeds hold no slot.
         # NOTE: core.mark_started already ran in TPUEngine._admit.
+        if self.policy is not None:
+            # Decision point (a): slot-admission order (fcfs: no-op).
+            self.policy.reorder_pending(self.pending_prefill)
         admitted: List[Request] = []
         while self.pending_prefill:
-            if self.pending_prefill[0]._retry_at > time.monotonic():
+            head = self.pending_prefill[0]
+            if head._retry_at > time.monotonic():
                 break  # head is backing off after a contained fault
+            if (len(self.active) >= self.ecfg.max_slots
+                    and not (self.is_encoder or head.kind == "embed")
+                    and not head.cancelled.is_set()
+                    and not head.expired()):
+                break  # batch full: the policy order decides who's next
             req = self.pending_prefill.popleft()
             if req.cancelled.is_set():
                 core.mark_dropped(req.user)
@@ -196,11 +228,7 @@ class FakeRuntime:
                 chunk = req.emit_text(word)
                 if chunk is None:
                     self.active.remove(req)
-                    core.mark_done(req.user, tokens=len(req.generated_ids))
-                    req.stats.completion_tokens = len(req.generated_ids)
-                    self._jrec("finish", req, reason="stop",
-                               tokens=len(req.generated_ids))
-                    req.finish(FinishReason.STOP)
+                    self._finish_served(req, core, FinishReason.STOP)
                     break
                 if chunk:
                     req.stream.push(StreamItem("token", text=chunk))
@@ -209,11 +237,7 @@ class FakeRuntime:
                     tail = req.flush_text()
                     if tail:
                         req.stream.push(StreamItem("token", text=tail))
-                    core.mark_done(req.user, tokens=len(req.generated_ids))
-                    req.stats.completion_tokens = len(req.generated_ids)
-                    self._jrec("finish", req, reason="length",
-                               tokens=len(req.generated_ids))
-                    req.finish(FinishReason.LENGTH)
+                    self._finish_served(req, core, FinishReason.LENGTH)
                     break
 
     def _fake_embedding(self, req: Request) -> list:
@@ -272,6 +296,7 @@ class FakeEngine(TPUEngine):
         rt.slo = self.slo
         rt.fault_plan = self.fault_plan
         rt.journal = self.journal
+        rt.policy = self.policy
         self.runtimes[name] = rt
         self.notify()
 

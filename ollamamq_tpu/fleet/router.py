@@ -933,6 +933,21 @@ class FleetRouter:
             "queued": self.core.total_queued(),
         }
 
+    def scheduler_stats(self) -> dict:
+        """Fleet scheduling readout (TUI sched chip / stats): local
+        members schedule in-process with the forwarded --scheduler
+        (their member config carries it); subprocess/HTTP members
+        receive the same flag through their own SCHEDULER env (the
+        docker-compose fleet services). Reports the first local
+        member's live policy + predictor accuracy, or the configured
+        policy name for a pure HTTP-member router."""
+        for mem in self.local_members:
+            eng = mem.engine
+            if getattr(eng, "policy", None) is not None:
+                return eng.scheduler_stats()
+        return {"policy": getattr(self.ecfg, "scheduler", "fcfs"),
+                "pred_accuracy": None, "pred_observed": 0, "decisions": 0}
+
     def stats(self) -> dict:
         runtime_stats = []
         for mem in self.local_members:
@@ -956,5 +971,6 @@ class FleetRouter:
             "shed": dict(self.shed_counts),
             "preemptions": self.preemption_count(),
             "retries": self.retry_count(),
+            "scheduler": self.scheduler_stats(),
             "fleet": self.fleet_status(),
         }

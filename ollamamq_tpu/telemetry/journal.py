@@ -44,6 +44,8 @@ from ollamamq_tpu.telemetry import schema as tm
 EVENTS = (
     "enqueue",        # arrival accepted into the fair-share queue
     "admit",          # scheduler popped the request for placement
+    "sched",          # scheduling policy applied an ordering decision
+    #                   (admission window / preemption victim) + inputs
     "place",          # placed onto a runtime (replica chosen)
     "shed",           # refused/dropped instead of served, by reason
     "batch",          # prefill batch composed (slots/bucket/occupancy)
@@ -80,6 +82,12 @@ EVENT_FIELDS: Dict[str, Tuple[tuple, tuple]] = {
     "enqueue": (("n_prompt", "queued"),
                 ("kind_req", "max_tokens", "deadline_ms")),
     "admit": (("queued",), ()),
+    # Policy ordering decisions carry their score inputs: which policy
+    # chose, at which decision point ("admit" window / "victim" pick),
+    # over how many candidates, and the chosen request's predicted
+    # output length + effective (aged) score — the explainability
+    # contract for "why did THIS request go first / lose its slot".
+    "sched": (("policy", "point"), ("candidates", "score", "predicted")),
     "place": (("runtime",), ()),
     "shed": (("reason",),
              ("queued", "limit", "retry_after_s", "n_prompt", "max_tokens")),
@@ -111,7 +119,10 @@ EVENT_FIELDS: Dict[str, Tuple[tuple, tuple]] = {
     "retry": (("n",), ("error",)),
     "poison": (("retries",), ("error",)),
     "deadline_drop": (("slack_ms",), ()),
-    "finish": (("reason",), ("slot", "tokens")),
+    # `predicted_tokens` pairs the scheduler's output-length prediction
+    # with the actual outcome (`tokens`) — per-policy predictor accuracy
+    # is auditable straight off the journal.
+    "finish": (("reason",), ("slot", "tokens", "predicted_tokens")),
     "page_alloc": (("n", "free", "used", "cached", "pool"), ("slot",)),
     "page_free": (("n", "free", "used", "cached", "pool"), ("slot",)),
     "page_evict": (("n", "free", "used", "cached", "pool"), ()),
@@ -137,15 +148,16 @@ _FIELD_SETS = {k: (frozenset(req), frozenset(req) | frozenset(opt))
 # bookkeeping (chunk/broadcast) carry device/layout detail that replay
 # harnesses without real KV pools can't reproduce; everything
 # scheduler-visible is in.
-DECISION_KINDS = ("enqueue", "admit", "place", "shed", "batch", "install",
-                  "preempt", "requeue", "retry", "poison", "deadline_drop",
-                  "finish", "replica_eject", "replica_failover",
-                  "replica_drain", "replica_join")
+DECISION_KINDS = ("enqueue", "admit", "sched", "place", "shed", "batch",
+                  "install", "preempt", "requeue", "retry", "poison",
+                  "deadline_drop", "finish", "replica_eject",
+                  "replica_failover", "replica_drain", "replica_join")
 
 # Per-kind fields folded into the replay signature (deterministic given
 # the same arrivals; excludes timestamps, latencies, and page ids).
 _SIG_FIELDS = {
     "enqueue": ("n_prompt", "queued"),
+    "sched": ("policy", "point", "candidates"),
     "shed": ("reason",),
     "place": ("runtime",),
     "retry": ("n",),
@@ -338,6 +350,18 @@ def explain(rec: dict) -> str:
                 f"queue depth {rec.get('queued', '?')}")
     if kind == "admit":
         return f"{who} admitted (queue depth {rec.get('queued', '?')})"
+    if kind == "sched":
+        verb = ("picked as preemption victim"
+                if rec.get("point") == "victim" else "ordered first")
+        s = f"{who} {verb} by policy {rec.get('policy', '?')}"
+        if rec.get("candidates") is not None:
+            s += f" among {rec['candidates']} candidate(s)"
+        if rec.get("predicted") is not None:
+            s += f" (predicted {rec['predicted']} token(s)"
+            if rec.get("score") is not None:
+                s += f", score {rec['score']}"
+            s += ")"
+        return s
     if kind == "place":
         return f"{who} placed on runtime {rec.get('runtime', '?')}"
     if kind == "shed":

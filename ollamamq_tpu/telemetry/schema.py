@@ -147,6 +147,21 @@ SPEC_ACCEPT_RATE = REGISTRY.gauge(
     "accounting", labels=("model",))
 
 
+# -- size-aware scheduling (engine/scheduler.py; --scheduler) --------------
+SCHED_PRED_ERR = REGISTRY.histogram(
+    "ollamamq_sched_pred_err",
+    "Output-length predictor absolute error in tokens (|predicted - "
+    "actual|), observed at request finish — the srpt/edf scheduling "
+    "policies order by these predictions, so this histogram is the "
+    "promotion guardrail's live twin",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512), labels=("model",))
+SCHED_DECISIONS_TOTAL = REGISTRY.counter(
+    "ollamamq_sched_decisions_total",
+    "Scheduling-policy reorder decisions applied (admission windows, "
+    "pending-queue reorders), by policy; fcfs never reorders so its "
+    "series stays 0", labels=("policy",))
+
+
 def total_shed() -> float:
     """Sum of ollamamq_shed_total over all reasons (TUI chip)."""
     return sum(child.value for _, child in SHED_TOTAL.series())

@@ -30,6 +30,17 @@ Record/replay (the determinism acceptance loop):
         (telemetry/journal.py decision_signature). Exit 0 on a perfect
         match, 1 with the first divergence printed otherwise.
 
+    simulate FILE --scheduler X
+        the offline policy evaluator: re-drive a recorded run's arrival
+        sequence under an ALTERNATIVE scheduling policy (fcfs/srpt/edf)
+        and report counterfactual p50/p99 TTFT/TPOT and queue-wait (in
+        virtual ticks) against the recorded run, plus the simulated
+        run's invariant check and decision-signature digest. Running the
+        same simulate twice is deterministic (identical signature), and
+        `simulate --scheduler fcfs` of an fcfs recording IS a replay —
+        so the promotion story is: record a trace, simulate every
+        policy, ship the winner behind --scheduler.
+
 Stdlib + engine imports only on demand: tail/explain/stats/check need no
 jax and no engine.
 """
@@ -41,6 +52,7 @@ import json
 import sys
 from typing import List, Optional
 
+from ollamamq_tpu.config import SCHEDULERS
 from ollamamq_tpu.telemetry.journal import (EVENTS, Journal, batch_stats,
                                             check_invariants,
                                             decision_signature, explain,
@@ -57,6 +69,15 @@ _SCENARIO_ENGINE = {"max_slots": 4, "max_queued": 6,
 _SCENARIO_FAULTS = {"seed": 0, "faults": [
     {"site": "step", "kind": "exception", "every": 7, "times": 4},
 ]}
+
+# The bimodal scenario: many short interactive requests + a few long
+# batch ones over a tiny slot pool and an UNBOUNDED queue — the regime
+# where SRPT-style shortest-predicted-remaining-first beats FIFO on p99
+# TTFT (a long output parked in a slot makes the shorts behind it wait).
+# No injected faults: the counterfactual readout is pure ordering.
+_BIMODAL_ENGINE = {"max_slots": 4, "max_queued": 0,
+                   "max_queued_per_user": 0, "step_retries": 1}
+_BIMODAL_FAULTS = {"seed": 0, "faults": []}
 
 
 def check_no_dropped_streams(records: List[dict]) -> List[str]:
@@ -103,6 +124,34 @@ def _gen_arrivals(seed: int, n: int) -> List[dict]:
     return out
 
 
+def _gen_bimodal(seed: int, n: int) -> List[dict]:
+    """Bimodal arrivals: ~1 in 5 is a long batch request (the fake
+    runtime's 16-token ceiling, long prompt), the rest short interactive
+    ones (2 tokens, short prompt). Longs bias EARLY so FIFO parks them
+    in the tiny slot pool ahead of the short burst — exactly the regime
+    the SRPT counterfactual is supposed to win."""
+    import random
+
+    rng = random.Random(seed)
+    out, tick = [], 0
+    for i in range(n):
+        if rng.random() < 0.5:
+            tick += 1
+        # Front-loaded longs: the first arrivals of each burst are the
+        # batch jobs, mirroring "one long request parked ahead of a
+        # burst of short interactive ones".
+        long = rng.random() < (0.5 if i < n // 6 else 0.12)
+        if long:
+            out.append({"tick": tick, "user": f"batch{rng.randrange(2)}",
+                        "n_prompt": rng.randrange(24, 60),
+                        "max_tokens": 16})
+        else:
+            out.append({"tick": tick, "user": f"chat{rng.randrange(6)}",
+                        "n_prompt": rng.randrange(3, 10),
+                        "max_tokens": 2})
+    return out
+
+
 def _arrivals_from_records(records: List[dict]) -> List[dict]:
     """The recorded arrival sequence: every accepted enqueue AND every
     admission-shed attempt (a shed arrival never became a Request, but
@@ -130,9 +179,13 @@ def drive_chaos(arrivals: List[dict], fault_plan: dict, engine: dict,
     from ollamamq_tpu.ops.sampling import SamplingParams
     from ollamamq_tpu.testing.faults import FaultPlan
 
+    # A fault-free scenario (the bimodal scheduling trace) passes an
+    # empty rule list; FaultPlan requires >= 1 rule, so that means "no
+    # plan" rather than an empty one.
+    plan = (FaultPlan.from_dict(fault_plan)
+            if (fault_plan or {}).get("faults") else None)
     ecfg = EngineConfig(model="test-tiny", retry_backoff_s=0.0,
-                        fault_plan=FaultPlan.from_dict(fault_plan),
-                        **engine)
+                        fault_plan=plan, **engine)
     eng = FakeEngine(ecfg, blocklist_path=None)
     eng.journal = journal  # the caller's journal (file spill, meta)
     for rt in eng._step_targets():
@@ -173,17 +226,101 @@ def drive_chaos(arrivals: List[dict], fault_plan: dict, engine: dict,
     return eng
 
 
-def record_chaos(path: str, seed: int = 0, requests: int = 24) -> Journal:
-    """Record one seeded chaos run to `path` (JSONL + scenario meta);
-    returns the in-memory journal."""
-    arrivals = _gen_arrivals(seed, requests)
+def record_chaos(path: str, seed: int = 0, requests: int = 24,
+                 trace: str = "chaos", scheduler: str = "fcfs") -> Journal:
+    """Record one seeded run to `path` (JSONL + scenario meta); returns
+    the in-memory journal. trace="chaos" is the degradation storm
+    (bounded queue + injected step faults); trace="bimodal" is the
+    scheduling workload (short interactive + long batch arrivals, no
+    faults) the `simulate` counterfactual evaluator feeds on. The
+    scheduler lands in the scenario meta so replay re-drives under the
+    SAME policy."""
+    if trace == "bimodal":
+        arrivals = _gen_bimodal(seed, requests)
+        engine, faults = dict(_BIMODAL_ENGINE), dict(_BIMODAL_FAULTS)
+    else:
+        arrivals = _gen_arrivals(seed, requests)
+        engine, faults = dict(_SCENARIO_ENGINE), dict(_SCENARIO_FAULTS)
+    engine["scheduler"] = scheduler
     meta = {"scenario": {"seed": seed, "requests": requests,
-                         "engine": dict(_SCENARIO_ENGINE),
-                         "fault_plan": dict(_SCENARIO_FAULTS)}}
+                         "trace": trace, "engine": engine,
+                         "fault_plan": faults}}
     journal = Journal(capacity=max(4096, requests * 64), path=path,
                       meta=meta)
-    drive_chaos(arrivals, _SCENARIO_FAULTS, _SCENARIO_ENGINE, journal)
+    drive_chaos(arrivals, faults, engine, journal)
     return journal
+
+
+def simulate_journal(path: str, scheduler: str):
+    """Counterfactually re-drive a recorded run's arrival sequence under
+    `scheduler` (the offline policy evaluator behind the promotion
+    workflow). Returns (recorded_records, simulated_records). Same
+    machinery as replay — synchronous virtual-tick driving — so the
+    simulated decision stream is a pure function of (recording, policy):
+    the same simulate twice yields an identical decision_signature."""
+    meta, records = load_jsonl(path)
+    scenario = meta.get("scenario")
+    if not scenario:
+        raise SystemExit(
+            f"{path} carries no scenario meta: simulate needs a journal "
+            "written by `tools/journal record` (a live engine's spill "
+            "lacks the engine shape + fault plan to re-drive)")
+    arrivals = _arrivals_from_records(records)
+    engine = dict(scenario["engine"])
+    engine["scheduler"] = scheduler
+    fresh = Journal(capacity=max(4096, len(records) * 4 + 64))
+    drive_chaos(arrivals, scenario["fault_plan"], engine, fresh)
+    return records, fresh.tail(None)
+
+
+def counterfactual_stats(records: List[dict]) -> dict:
+    """Per-request latency stats in VIRTUAL TICKS off a synchronously
+    driven journal: TTFT = enqueue -> install tick (the fake runtime
+    emits the first token in its install tick), queue wait = enqueue ->
+    admission pop, TPOT = decode ticks per emitted token. Tick deltas,
+    not wall-clock — the whole point of the synchronous driver is that
+    wall-clock never reaches a decision."""
+    enq: dict = {}
+    adm: dict = {}
+    inst: dict = {}
+    fin: dict = {}
+    toks: dict = {}
+    for r in records:
+        rid = r.get("req_id")
+        if rid is None:
+            continue
+        t = int(r.get("tick", 0))
+        kind = r.get("kind")
+        if kind == "enqueue":
+            enq.setdefault(rid, t)
+        elif kind == "admit":
+            adm.setdefault(rid, t)
+        elif kind == "install":
+            inst.setdefault(rid, t)
+        elif kind == "finish":
+            fin.setdefault(rid, t)
+            toks.setdefault(rid, int(r.get("tokens") or 0))
+
+    def pctl(xs, q):
+        if not xs:
+            return None
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+    ttfts = [inst[r] - enq[r] for r in inst if r in enq]
+    waits = [adm[r] - enq[r] for r in adm if r in enq]
+    tpots = [(fin[r] - inst[r]) / max(1, toks.get(r, 1))
+             for r in fin if r in inst]
+    return {
+        "served": len(ttfts),
+        "ttft_p50": pctl(ttfts, 0.5),
+        "ttft_p99": pctl(ttfts, 0.99),
+        "ttft_mean": (round(sum(ttfts) / len(ttfts), 2) if ttfts else None),
+        "tpot_p50": (round(pctl(tpots, 0.5), 3) if tpots else None),
+        "tpot_p99": (round(pctl(tpots, 0.99), 3) if tpots else None),
+        "queue_wait_mean": (round(sum(waits) / len(waits), 2)
+                            if waits else None),
+    }
 
 
 def replay_journal(path: str):
@@ -277,8 +414,42 @@ def _cmd_check(args) -> int:
     return 0
 
 
+def _cmd_simulate(args) -> int:
+    import hashlib
+
+    recorded, simulated = simulate_journal(args.file, args.scheduler)
+    base = counterfactual_stats(recorded)
+    cf = counterfactual_stats(simulated)
+    sig = decision_signature(simulated)
+    digest = hashlib.sha256(repr(sig).encode()).hexdigest()[:16]
+    print(f"simulate --scheduler {args.scheduler}: {len(simulated)} "
+          f"records, {len(sig)} decisions, "
+          f"decision_signature {digest}")
+    print("counterfactual vs recorded (virtual ticks):")
+    print(f"  {'metric':<16} {'recorded':>10} {'simulated':>10} "
+          f"{'delta':>10}")
+    for k in ("served", "ttft_p50", "ttft_p99", "ttft_mean",
+              "tpot_p50", "tpot_p99", "queue_wait_mean"):
+        a, b = base.get(k), cf.get(k)
+        delta = (round(b - a, 3)
+                 if isinstance(a, (int, float)) and isinstance(b, (int, float))
+                 else "-")
+        print(f"  {k:<16} {str(a):>10} {str(b):>10} {str(delta):>10}")
+    bad = check_invariants(simulated)
+    if bad:
+        print(f"{len(bad)} invariant violation(s) in the simulated run:",
+              file=sys.stderr)
+        for b in bad:
+            print(f"  - {b}", file=sys.stderr)
+        return 1
+    print("simulated run invariant-clean")
+    return 0
+
+
 def _cmd_record(args) -> int:
-    journal = record_chaos(args.file, seed=args.seed, requests=args.requests)
+    journal = record_chaos(args.file, seed=args.seed,
+                           requests=args.requests, trace=args.trace,
+                           scheduler=args.scheduler)
     recs = journal.tail(None)
     kinds: dict = {}
     for r in recs:
@@ -338,7 +509,25 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("file")
     sp.add_argument("--seed", type=int, default=0)
     sp.add_argument("--requests", type=int, default=24)
+    sp.add_argument("--trace", choices=("chaos", "bimodal"),
+                    default="chaos",
+                    help="arrival workload: 'chaos' (degradation storm, "
+                         "injected faults) or 'bimodal' (short "
+                         "interactive + long batch requests, no faults "
+                         "— the scheduling counterfactual's input)")
+    sp.add_argument("--scheduler", choices=SCHEDULERS, default="fcfs",
+                    help="policy the RECORDED run schedules under "
+                         "(lands in the scenario meta so replay "
+                         "re-drives it identically)")
     sp.set_defaults(fn=_cmd_record)
+    sp = sub.add_parser("simulate")
+    sp.add_argument("file")
+    sp.add_argument("--scheduler", choices=SCHEDULERS, default="srpt",
+                    help="counterfactual policy to re-drive the "
+                         "recorded arrival sequence under; reports "
+                         "p50/p99 TTFT/TPOT + queue-wait deltas vs the "
+                         "recorded run")
+    sp.set_defaults(fn=_cmd_simulate)
     return p
 
 

@@ -72,6 +72,8 @@ def test_schema_validation_is_loud():
 _MINIMAL = {
     "enqueue": dict(n_prompt=4, queued=1),
     "admit": dict(queued=0),
+    "sched": dict(policy="srpt", point="admit", candidates=3, score=5.25,
+                  predicted=6),
     "place": dict(runtime="m"),
     "shed": dict(reason="queue_full", queued=9, limit=8, retry_after_s=2.0),
     "batch": dict(slots=[0, 1], bucket=32, batch_size=4, tokens=40,
