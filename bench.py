@@ -979,16 +979,20 @@ def _scheduling_scenario(args, touch):
 
 def _fleet_scenario(args, rng, touch):
     """Fleet robustness acceptance: the SAME seeded arrival trace runs
-    (a) through a single-replica fleet untouched (the golden leg) and
+    (a) through a single-replica fleet untouched (the golden leg),
     (b) through an N-replica fleet under kill-and-drain chaos — a seeded
     `replica` fault plan crashes a member mid-serving and a mid-run
-    drain_replica exercises the zero-drop rolling-restart path. The
-    contract checked in-band: dropped_streams == 0, silent_truncations
-    == 0, journal invariants (incl. no-dropped-streams) clean, and every
+    drain_replica exercises the zero-drop rolling-restart path — with
+    KV migration ON (recovery resumes from shipped state), and
+    (c) the same chaos trace with migration OFF (every recovery is a
+    recompute replay). The contract checked in-band: dropped_streams ==
+    0, silent_truncations == 0, journal invariants (incl.
+    no-dropped-streams and migration handoff pairing) clean, every
     stream — failed-over ones included — byte-identical to the golden
-    leg. Members are tiny real engines (test-tiny, prefix cache on so
-    affinity placement has a radix signal); the readout is robustness
-    counters, not throughput."""
+    leg, and the migration gate: leg (b) recomputes >= 5x fewer tokens
+    than leg (c). Members are tiny real engines (test-tiny, prefix
+    cache on so affinity placement has a radix signal); the readout is
+    robustness counters, not throughput."""
     import dataclasses
     import time
 
@@ -1021,7 +1025,7 @@ def _fleet_scenario(args, rng, touch):
                  + rng.integers(3, 500, size=6).tolist())
                 for i in range(n_total)]
 
-    def run_leg(replicas, plan, drain):
+    def run_leg(replicas, plan, drain, migrate=True, late_kill=False):
         ecfg = EngineConfig(fault_plan=plan, **member_kw)
         member_cfg = dataclasses.replace(ecfg, fault_plan=None)
         members = [
@@ -1034,13 +1038,17 @@ def _fleet_scenario(args, rng, touch):
         # compile inside one engine iteration doesn't read as a hung
         # loop; the injected kill is detected via thread death, not
         # staleness, so it still ejects immediately.
+        # migrate_timeout bounds how long an export may wait on a
+        # wedged (e.g. mid-compile) member before recompute takes over.
         router = FleetRouter(
             members, ecfg, blocklist_path=None, probe_period_s=0.1,
             eject_heartbeat_s=5.0, reprobe_backoff_s=0.2,
-            evac_grace_s=1.0, drain_timeout_s=8.0)
+            evac_grace_s=1.0, drain_timeout_s=8.0, migrate=migrate,
+            migrate_timeout_s=2.0)
         router.start()
         reqs, rids, items = [], [], []
         issued, drained = 0, not drain
+        killed_late = not late_kill
         t0 = time.monotonic()
         deadline = t0 + 600.0
         try:
@@ -1051,10 +1059,23 @@ def _fleet_scenario(args, rng, touch):
                         f"{sum(1 for r in reqs if not r.stats.finished_at)}"
                         " unresolved")
                 done = sum(1 for r in reqs if r.stats.finished_at)
-                # Bounded in-flight issuance: the trace stretches across
-                # the whole serving window, so the chaos lands mid-stream.
-                while issued < n_total and issued - done < 4 * member_kw[
-                        "max_slots"]:
+                # Progress-triggered mid-serving kill (identical in both
+                # chaos legs): lands deterministically once the engines
+                # are warm and streams are mid-decode — the regime where
+                # migrating shipped state vs recomputing it actually
+                # differs. The plan's sweep-counted kill stays for the
+                # mid-compile (0-token) edge.
+                if not killed_late and done >= n_total // 2:
+                    router._member(f"r{replicas - 1}").crash()
+                    killed_late = True
+                # Bounded in-flight issuance with slot HEADROOM (3/2 x
+                # one member's slots across N members): the trace
+                # stretches across the whole serving window so the chaos
+                # lands mid-stream, while the surviving member keeps
+                # free slots for migrated/replayed victims to land in —
+                # this is a robustness readout, not a saturation one.
+                while issued < n_total and issued - done < 3 * member_kw[
+                        "max_slots"] // 2:
                     user, prompt = arrivals[issued]
                     req = router.enqueue_request(
                         user, "", "test-tiny", prompt_tokens=prompt,
@@ -1085,6 +1106,8 @@ def _fleet_scenario(args, rng, touch):
                 "rids": rids,
                 "journal": jrecs,
                 "failovers": router.failover_count,
+                "migrations": router.migration_count,
+                "migrate_aborts": router.migrate_abort_count,
                 "elapsed_s": round(time.monotonic() - t0, 3),
             }
         finally:
@@ -1097,11 +1120,23 @@ def _fleet_scenario(args, rng, touch):
     # s * n_members crashes the LAST member on sweep s. One kill lands
     # early (sweep 10, ~1s — often mid-compile, exercising 0-token
     # failovers) and one mid-serving (sweep 45, ~4.5s) if the run lasts
-    # that long.
-    plan = FaultPlan([{"site": "replica", "kind": "exception",
-                      "at": [10 * n_members, 45 * n_members],
-                      "times": 2}], seed=7)
-    chaos = run_leg(n_members, plan, drain=True)
+    # that long. A FRESH plan per leg: the per-site call counters are
+    # stateful, and the migration A/B below must see the same kills.
+    def kill_plan():
+        return FaultPlan([{"site": "replica", "kind": "exception",
+                           "at": [10 * n_members, 45 * n_members],
+                           "times": 2}], seed=7)
+
+    chaos = run_leg(n_members, kill_plan(), drain=True, late_kill=True)
+    # Affinity delta bounds to the chaos leg only (the recompute leg
+    # below increments the same process-global counter).
+    chaos_affinity = int(tm.FLEET_AFFINITY_HITS_TOTAL.value - affinity0)
+    # Migration A/B: the SAME kill-and-drain chaos trace with migration
+    # disabled — every recovery recomputes. The gate: migration
+    # recomputes >= 5x fewer tokens (journal replayed_tokens), still
+    # with zero drops and clean invariants on both legs.
+    recompute = run_leg(n_members, kill_plan(), drain=True, migrate=False,
+                        late_kill=True)
 
     mismatches = [i for i, (a, b) in enumerate(zip(golden["texts"],
                                                    chaos["texts"]))
@@ -1118,8 +1153,13 @@ def _fleet_scenario(args, rng, touch):
     dropped = sum(1 for t in chaos["terminals"] if t is None)
     jrecs = chaos["journal"]
     violations = check_invariants(jrecs) + check_no_dropped_streams(jrecs)
+    # Victim streams = everything a recovery touched, whether it rode a
+    # migration (migrate_import, prefix shipments excluded) or the
+    # recompute replay (replica_failover).
     failover_rids = {r.get("req_id") for r in jrecs
-                     if r["kind"] == "replica_failover"}
+                     if r["kind"] == "replica_failover"
+                     or (r["kind"] == "migrate_import"
+                         and r.get("what") != "prefix")}
     failover_idx = [i for i, rid in enumerate(chaos["rids"])
                     if rid in failover_rids]
     outcomes: dict = {}
@@ -1128,7 +1168,45 @@ def _fleet_scenario(args, rng, touch):
                   if t is not None and t.finish_reason else "none")
         outcomes[reason] = outcomes.get(reason, 0) + 1
     placements = sum(1 for r in jrecs if r["kind"] == "place")
-    affinity_hits = int(tm.FLEET_AFFINITY_HITS_TOTAL.value - affinity0)
+    affinity_hits = chaos_affinity
+
+    # Migration leg readout: recomputed tokens = what each leg's
+    # recoveries replayed (replica_failover.replayed_tokens); the
+    # migration leg's shipped tokens rode migrate_import instead.
+    def recomputed_tokens(recs):
+        return sum(int(r.get("replayed_tokens") or 0) for r in recs
+                   if r["kind"] == "replica_failover")
+
+    recomputed_off = recomputed_tokens(recompute["journal"])
+    recomputed_on = recomputed_tokens(jrecs)
+    shipped = sum(int(r.get("tokens") or 0) for r in jrecs
+                  if r["kind"] == "migrate_import"
+                  and r.get("what") != "prefix")
+    rec_mismatch = [i for i, (a, b) in enumerate(zip(golden["texts"],
+                                                     recompute["texts"]))
+                    if a != b]
+    rec_violations = (check_invariants(recompute["journal"])
+                      + check_no_dropped_streams(recompute["journal"]))
+    rec_dropped = sum(1 for t in recompute["terminals"] if t is None)
+    migration = {
+        "migrations": chaos["migrations"],
+        "migrate_aborts": chaos["migrate_aborts"],
+        "shipped_tokens": shipped,
+        "recomputed_tokens_migrate_on": recomputed_on,
+        "recomputed_tokens_migrate_off": recomputed_off,
+        "recompute_leg_mismatches": len(rec_mismatch),
+        "recompute_leg_dropped": rec_dropped,
+        "recompute_leg_invariant_violations": len(rec_violations),
+        "elapsed_s_migrate_off": recompute["elapsed_s"],
+        # Gate: resuming from shipped state must recompute >= 5x fewer
+        # tokens than recompute-only recovery on the same chaos trace,
+        # with zero drops and clean invariants on both legs.
+        "pass": bool(
+            recomputed_on * 5 <= recomputed_off
+            and (recomputed_off > 0 or chaos["migrations"] > 0)
+            and dropped == 0 and rec_dropped == 0
+            and not violations and not rec_violations),
+    }
     return {
         "requests": n_total,
         "replicas": n_members,
@@ -1149,6 +1227,7 @@ def _fleet_scenario(args, rng, touch):
         "affinity_hit_ratio": round(affinity_hits / max(1, placements), 4),
         "invariant_violations": len(violations),
         "outcomes": outcomes,
+        "migration": migration,
         "elapsed_s_golden": golden["elapsed_s"],
         "elapsed_s_chaos": chaos["elapsed_s"],
     }

@@ -22,6 +22,8 @@ set -- --no-tui --host 0.0.0.0
 [ -n "${PLACEMENT:-}" ] && set -- "$@" --placement "$PLACEMENT"
 [ -n "${SCHEDULER:-}" ] && set -- "$@" --scheduler "$SCHEDULER"
 [ -n "${DRAIN_TIMEOUT_S:-}" ] && set -- "$@" --drain-timeout-s "$DRAIN_TIMEOUT_S"
+[ "${MIGRATE:-}" = "false" ] && set -- "$@" --no-migrate
+[ -n "${MIGRATE_TIMEOUT_S:-}" ] && set -- "$@" --migrate-timeout-s "$MIGRATE_TIMEOUT_S"
 [ -n "${MAX_SLOTS:-}" ] && set -- "$@" --max-slots "$MAX_SLOTS"
 [ -n "${BLOCKLIST:-}" ] && set -- "$@" --blocklist "$BLOCKLIST"
 [ "${ALLOW_ALL_ROUTES:-}" = "true" ] && set -- "$@" --allow-all-routes

@@ -155,6 +155,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "to finish on a draining replica before the "
                         "stragglers fail over (still zero dropped "
                         "streams)")
+    p.add_argument("--no-migrate", action="store_true",
+                   default=os.environ.get("MIGRATE", "").lower()
+                   in ("0", "false", "no"),
+                   help="disable KV page migration: failover and drain "
+                        "fall back to recompute replay (prompt + every "
+                        "emitted token) instead of shipping KV pages + "
+                        "request state to a healthy member, and affinity "
+                        "misses stop shipping cached prefixes")
+    p.add_argument("--migrate-timeout-s", type=float,
+                   default=float(os.environ.get("MIGRATE_TIMEOUT_S", 10.0)),
+                   help="per-transfer migration budget: a transfer "
+                        "(export + ship + import ack) past this aborts "
+                        "and the stream falls back to recompute replay")
     # Graceful degradation under load.
     p.add_argument("--max-queued", type=int, default=0,
                    help="global queued-request cap: past it, enqueues are "
@@ -335,6 +348,9 @@ def main(argv=None) -> int:
     if args.drain_timeout_s <= 0:
         log.error("--drain-timeout-s must be > 0")
         return 2
+    if args.migrate_timeout_s <= 0:
+        log.error("--migrate-timeout-s must be > 0")
+        return 2
     # Quantization flags fail fast BEFORE any device/runtime work: an
     # unsupported combination must kill the process at startup, not at
     # the first dispatch (same validator the SPMD worker and the
@@ -440,6 +456,8 @@ def main(argv=None) -> int:
         replicas=args.replicas,
         placement=args.placement,
         drain_timeout_s=args.drain_timeout_s,
+        migrate=not args.no_migrate,
+        migrate_timeout_s=args.migrate_timeout_s,
     )
     fairness = Fairness.TOKENS if args.token_fairness else Fairness.REQUESTS
 

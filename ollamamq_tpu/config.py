@@ -333,6 +333,17 @@ class EngineConfig:
     # POST /admin/drain/{replica}: in-flight streams get this long to
     # complete before the stragglers fail over to healthy replicas.
     drain_timeout_s: float = 30.0
+    # KV page migration: failover/drain first tries to SHIP a victim
+    # stream's KV pages + request state to a healthy member (resume from
+    # shipped state, zero recomputed tokens), falling back to the
+    # recompute replay when the source can't export or the transfer
+    # fails; affinity misses may ship the cached prefix to the chosen
+    # member. Off => every failover/drain uses recompute replay.
+    migrate: bool = True
+    # Per-transfer budget: a migration (export + ship + import ack) past
+    # this aborts and falls back to recompute — a stalled transfer must
+    # never hold a stream hostage longer than re-deriving it would.
+    migrate_timeout_s: float = 10.0
     # -- scheduling policy (engine/scheduler.py) -----------------------------
     # Admission / prefill-packing / preemption-victim ordering: "fcfs"
     # (default; bit-identical to the pre-policy-extraction engine),

@@ -27,6 +27,7 @@ donated caches) => each (bucket, K) compiles exactly once.
 from __future__ import annotations
 
 import collections
+import copy
 import logging
 import os
 import threading
@@ -145,6 +146,62 @@ class QueueFullError(Exception):
         super().__init__(
             f"{scope.replace('_', ' ')}: admission cap {limit} reached; "
             f"retry after ~{retry_after_s:.0f}s")
+
+
+class MigrationError(RuntimeError):
+    """A KV migration import could not land (no slot / no pages / shape
+    mismatch / malformed blob). The caller falls back to recompute
+    replay — a failed transfer degrades, it never drops."""
+
+
+def request_migration_state(req: Request) -> dict:
+    """Everything a Request carries that the TARGET member of a KV
+    migration needs to continue the stream seamlessly: token history,
+    detokenizer text + emitted watermark (stop-string holdback included),
+    degradation budgets, and the sampling params verbatim."""
+    s = req.sampling
+    return {
+        "user": req.user, "model": req.model, "kind": req.kind,
+        "raw_prompt": req.raw_prompt,
+        "prompt_tokens": [int(t) for t in req.prompt_tokens],
+        "generated_ids": [int(t) for t in req.generated_ids],
+        "replay_gen": int(req._replay_gen),
+        "emitted_len": int(req.emitted_len),
+        "detok_text": req._detok_text,
+        "preemptions": int(req.preemptions),
+        "retries": int(req.retries),
+        "sampling": {
+            "temperature": s.temperature, "top_k": s.top_k,
+            "top_p": s.top_p, "repeat_penalty": s.repeat_penalty,
+            "presence_penalty": s.presence_penalty,
+            "frequency_penalty": s.frequency_penalty,
+            "seed": s.seed, "max_tokens": s.max_tokens,
+            "stop": list(s.stop), "deadline_ms": s.deadline_ms,
+        },
+    }
+
+
+def request_from_migration_state(rid: int, state: dict) -> Request:
+    """Rebuild a migrated Request. Sampling fields are set RAW (seed was
+    already folded into its seeded form on the source — running
+    __post_init__ again would re-fold it and fork the sampled stream)."""
+    from ollamamq_tpu.ops.sampling import SamplingParams
+
+    sp = SamplingParams()
+    for key, val in (state.get("sampling") or {}).items():
+        setattr(sp, key, val)
+    sp.stop = tuple(sp.stop or ())
+    req = Request(rid, state["user"], state.get("model", ""),
+                  [int(t) for t in state.get("prompt_tokens", ())], sp,
+                  kind=state.get("kind", "generate"),
+                  raw_prompt=state.get("raw_prompt", ""))
+    req.generated_ids = [int(t) for t in state.get("generated_ids", ())]
+    req._replay_gen = int(state.get("replay_gen", 0))
+    req.emitted_len = int(state.get("emitted_len", 0))
+    req._detok_text = state.get("detok_text", "")
+    req.preemptions = int(state.get("preemptions", 0))
+    req.retries = int(state.get("retries", 0))
+    return req
 
 
 class WorkerDesyncError(RuntimeError):
@@ -1255,8 +1312,11 @@ class ModelRuntime:
         if chunk is None:  # stop string fired: suppress held-back text
             self._finish_slot(slot, FinishReason.STOP, core, flush=False)
             return False
-        if chunk:
-            req.stream.push(StreamItem("token", text=chunk, token_id=tok))
+        # Push EVERY sampled token, text or not (held-back bytes mid
+        # UTF-8 sequence, stop-string holdback): the id stream must be
+        # complete for the fleet's token-space failover replay — text
+        # consumers already skip empty chunks.
+        req.stream.push(StreamItem("token", text=chunk, token_id=tok))
         # Stream-write stall attribution: a consumer backlog above the
         # high-water mark opens a "stream" span on the trace; dropping
         # back under closes it. Transition-edged so the event cap isn't
@@ -1646,6 +1706,168 @@ class ModelRuntime:
             # Token written at position n during the next decode step.
             self.last_tokens[slot] = tok
             self.seq_lens[slot] = n
+
+    # -- KV page migration (fleet export/import; engine-thread only) -------
+    def export_request(self, rid: int):
+        """Snapshot + DETACH one installed decode slot for migration.
+        Returns (handle, blob) or None when `rid` holds no installed slot
+        (queued / mid-prefill / chunking work replays cheaply via
+        recompute — only written decode state is worth shipping). The
+        detached slot keeps its pages (reserved, undispatchable) until
+        release_export resolves the two-phase handoff."""
+        for slot, req in enumerate(self.slot_req):
+            if req is not None and req.req_id == rid:
+                break
+        else:
+            return None
+        blob = self._migration_snapshot(slot, req)
+        self.slot_req[slot] = None
+        self.reserved_slots.add(slot)
+        self._stalled_slots.discard(slot)
+        return {"slot": slot, "req": req}, blob
+
+    def _migration_snapshot(self, slot: int, req: Request) -> dict:
+        """The portable wire state of one decode slot: its page run
+        (int8 payload + scale rows for quantized pools — ~2x cheaper to
+        move), the decode cursor (written kv_len + the pending last
+        token, mirroring the install convention), the penalty ring row,
+        request state, and the scheduler predictor's view of the user."""
+        pages = list(self.slot_pages[slot])
+        data = kvc.gather_page_run(self.kc, self.vc, pages,
+                                   self.ecfg.page_size)
+        blob = {
+            "version": 1, "kind": "stream", "model": self.name,
+            "kv_dtype": self.kv_dtype, "page_size": self.ecfg.page_size,
+            "num_layers": self.cfg.num_layers,
+            "num_kv_heads": self.cfg.num_kv_heads,
+            "head_dim": self.cfg.head_dim,
+            "kv_len": int(self.seq_lens[slot]),
+            "last_token": int(self.last_tokens[slot]),
+            "n_pages": len(pages),
+            "recent": np.asarray(self.recent[slot]),
+            "request": request_migration_state(req),
+            # In-process handoff carries the live incremental detokenizer
+            # (exact stream continuity); the wire packer drops it and the
+            # importer builds a fresh one off the carried detok text.
+            "_inc_decode": req._inc_decode,
+            **data,
+        }
+        pol = self.policy
+        if pol is not None:
+            blob["predictor"] = pol.predictor.export_user(req.user)
+        return blob
+
+    def release_export(self, handle: dict) -> None:
+        """Resolve a detached export (commit OR abort): the pages go the
+        same way a finished slot's do — full prompt pages merge into the
+        prefix cache (a recompute fallback then replays mostly from
+        cache), the rest return to the free list."""
+        slot, req = handle["slot"], handle["req"]
+        self.reserved_slots.discard(slot)
+        self._release_slot_pages(slot, req)
+        self._clear_slot(slot)
+
+    def import_request(self, blob: dict, req: Request) -> bool:
+        """Install a migrated stream into a fresh slot from shipped
+        state: allocate a same-length page run, scatter the wire pages
+        into this pool, and resume the decode cursor exactly where the
+        source froze it — no token is ever recomputed. False when the
+        blob's shape doesn't match this runtime or capacity is gone
+        (the caller falls back to recompute replay)."""
+        if (blob.get("kind") != "stream"
+                or int(blob.get("page_size", -1)) != self.ecfg.page_size
+                or blob.get("kv_dtype") != self.kv_dtype
+                or int(blob.get("num_layers", -1)) != self.cfg.num_layers
+                or int(blob.get("num_kv_heads", -1)) != self.cfg.num_kv_heads
+                or int(blob.get("head_dim", -1)) != self.cfg.head_dim):
+            return False
+        n = int(blob["n_pages"])
+        if n <= 0 or n > self.alloc.max_pages_per_seq:
+            return False
+        slot = self._claim_slot(set())
+        if slot is None:
+            return False
+        pages = self._alloc_tail(0, n * self.ecfg.page_size)
+        if pages is None:
+            return False
+        self.kc, self.vc = kvc.scatter_page_run(
+            self.kc, self.vc, pages, self.ecfg.page_size, blob)
+        self.recent = self.recent.at[slot].set(
+            jnp.asarray(np.asarray(blob["recent"], np.int32)))
+        self.slot_pages[slot] = pages
+        self.slot_pins[slot] = []
+        self.page_table[slot, :] = kvc.make_page_table_row(
+            pages, self.ecfg.max_pages_per_seq)
+        s = req.sampling
+        self.slot_req[slot] = req
+        self.seq_lens[slot] = int(blob["kv_len"])
+        self.last_tokens[slot] = int(blob["last_token"])
+        self.temp[slot] = s.temperature
+        self.top_k[slot] = s.top_k
+        self.top_p[slot] = s.top_p
+        self.rep_pen[slot] = s.repeat_penalty
+        self.pres_pen[slot] = s.presence_penalty
+        self.freq_pen[slot] = s.frequency_penalty
+        self.seeds[slot] = s.seed
+        if req._inc_decode is None:
+            req._inc_decode = self.tokenizer.make_incremental_decoder()
+        pol = self.policy
+        if pol is not None and blob.get("predictor"):
+            pol.predictor.import_user(req.user, blob["predictor"])
+        self._jrec("install", req, slot=slot,
+                   n_prompt=len(req.prompt_tokens))
+        return True
+
+    def export_prefix(self, tokens: List[int]):
+        """Affinity-miss prefix shipping, source side: the longest cached
+        full-page prefix of `tokens` as a wire blob (pages pinned only
+        for the device->host copy). None when nothing caches."""
+        pc = self.prefix_cache
+        if pc is None:
+            return None
+        nodes, pages = pc.match(list(tokens))
+        if not pages:
+            return None
+        pc.pin(nodes)
+        try:
+            data = kvc.gather_page_run(self.kc, self.vc, pages,
+                                       self.ecfg.page_size)
+        finally:
+            pc.release(nodes)
+        ps = self.ecfg.page_size
+        return {
+            "version": 1, "kind": "prefix", "model": self.name,
+            "kv_dtype": self.kv_dtype, "page_size": ps,
+            "num_layers": self.cfg.num_layers,
+            "num_kv_heads": self.cfg.num_kv_heads,
+            "head_dim": self.cfg.head_dim,
+            "n_pages": len(pages),
+            "prefix_tokens": [int(t) for t in tokens[:len(pages) * ps]],
+            **data,
+        }
+
+    def import_prefix(self, blob: dict) -> int:
+        """Affinity-miss prefix shipping, target side: land shipped
+        prefix pages in this pool and merge them into the radix tree, so
+        the request admitted next prefills only the tail. Plain alloc_n
+        (no eviction backstop): shipping a remote prefix must never
+        evict locally-earned cache. Returns pages adopted (0 = no-op)."""
+        pc = self.prefix_cache
+        if (pc is None or blob.get("kind") != "prefix"
+                or int(blob.get("page_size", -1)) != self.ecfg.page_size
+                or blob.get("kv_dtype") != self.kv_dtype
+                or int(blob.get("num_layers", -1)) != self.cfg.num_layers
+                or int(blob.get("num_kv_heads", -1)) != self.cfg.num_kv_heads
+                or int(blob.get("head_dim", -1)) != self.cfg.head_dim):
+            return 0
+        n = int(blob["n_pages"])
+        pages = self.alloc.alloc_n(n) if n > 0 else None
+        if pages is None:
+            return 0
+        self._jrec("page_alloc", n=n, **self._page_state())
+        self.kc, self.vc = kvc.scatter_page_run(
+            self.kc, self.vc, pages, self.ecfg.page_size, blob)
+        return pc.insert([int(t) for t in blob["prefix_tokens"]], pages)
 
     # -- speculative decoding (n-gram draft + ragged verify) ---------------
     # Accept-rate warmup sample per user before the auto-throttle may
@@ -3166,6 +3388,10 @@ class TPUEngine:
         self._engine_retries = 0  # retries issued by _retry_or_error
         self._orphans: List[tuple] = []
         self._expired_orphans: Dict[int, float] = {}
+        # In-flight KV migration exports: rid -> (runtime, handle). A
+        # detached slot parks here between migrate_export and the
+        # commit/abort that resolves the two-phase handoff.
+        self._migrations: Dict[int, tuple] = {}
         self._last_stuck_log = 0.0
         self._pending_lock = threading.Lock()
         self._cond = threading.Condition()
@@ -3290,12 +3516,20 @@ class TPUEngine:
         sampling=None,
         kind: str = "generate",
         raw_prompt: str = "",
+        context_ids=None,
     ) -> Request:
         """Atomically enqueue into the native core AND register the Request,
         so the engine loop can never pop a req_id it doesn't know yet.
         Raises BlockedError for blocked users/IPs, QueueFullError when a
         bounded-admission cap (--max-queued / --max-queued-per-user) is
-        hit — honest backpressure instead of an unbounded queue."""
+        hit — honest backpressure instead of an unbounded queue.
+
+        `context_ids` (Ollama's /api/generate `context` field, also the
+        fleet's token-space HTTP failover replay): token ids already
+        generated in a prior turn/attempt. They fold into the replay
+        prompt with generated_ids pre-filled — the engine's own
+        preemption-replay convention — so the decode continues exactly
+        after them and max_tokens still budgets NEW tokens only."""
         cfg = self.ecfg
         if cfg.max_queued and self.core.total_queued() >= cfg.max_queued:
             self._count_shed("queue_full")
@@ -3327,6 +3561,15 @@ class TPUEngine:
             )
             req = Request(rid, user, model, prompt_tokens or [], sampling,
                           kind=kind, raw_prompt=raw_prompt)
+            if context_ids:
+                ctx = [int(t) for t in context_ids]
+                sp = copy.copy(req.sampling)  # skip __post_init__ refold
+                sp.max_tokens = sp.max_tokens + len(ctx)
+                req.sampling = sp
+                req.prompt_tokens = list(req.prompt_tokens) + ctx
+                req.generated_ids = list(ctx)
+                req._replay_gen = len(ctx)
+                req.stats.prompt_tokens = len(req.prompt_tokens)
             req.trace = self.tracer.begin(rid, user, model, kind=kind)
             self.pending[rid] = req
         self.journal.record(
@@ -3403,6 +3646,173 @@ class TPUEngine:
                 continue
             best = max(best, len(pages))
         return best
+
+    # -- KV page migration (fleet export/import seam) ----------------------
+    def export_stream(self, rid: int, deadline: Optional[float] = None):
+        """Phase 1 of the two-phase handoff: snapshot + detach `rid`'s
+        decode slot into a portable blob, parking the source state until
+        resolve_export commits or aborts. Runs on the engine thread
+        (slot tables and the KV pool are loop state); `deadline` bounds
+        how long a caller will wait on a wedged loop — a late-running
+        export past it is a no-op, so the caller's recompute fallback
+        can never race a zombie detach. None = not exportable."""
+        def _do():
+            if deadline is not None and time.monotonic() > deadline:
+                return None
+            for rt in self._step_targets():
+                export = getattr(rt, "export_request", None)
+                if export is None:
+                    continue
+                out = export(rid)
+                if out is None:
+                    continue
+                handle, blob = out
+                self._migrations[rid] = (rt, handle)
+                req = handle["req"]
+                self.journal.record(
+                    "migrate_export", req=req,
+                    tokens=len(req.generated_ids),
+                    kv_len=blob.get("kv_len"), pages=blob.get("n_pages"))
+                return blob
+            return None
+
+        timeout = (max(0.05, deadline - time.monotonic())
+                   if deadline is not None else 30.0)
+        if not self._running:
+            # Crashed member (fleet kill): call_on_loop would run the
+            # export inline — but the loop thread may still be INSIDE
+            # its final iteration, mutating the very slot state the
+            # snapshot reads. Wait for it to die first; a loop that
+            # won't die within the budget is a recompute fallback, not
+            # a torn snapshot.
+            t = self._thread
+            if t is not None and t.is_alive():
+                t.join(timeout=timeout)
+                if t.is_alive():
+                    return None
+        try:
+            return self.call_on_loop(_do, timeout=timeout)
+        except TimeoutError:
+            return None  # wedged loop: the guarded fn no-ops if it runs
+
+    def resolve_export(self, rid: int, commit: bool = True,
+                       why: str = "") -> bool:
+        """Phase 2: release the parked source state. Commit and abort
+        free identically (full prompt pages merge into the prefix
+        cache); they differ in the journal story — an abort records WHY
+        the transfer failed, and the caller falls back to recompute.
+        The parked member-side request finishes CANCELLED either way so
+        its server handler / stream consumers unblock."""
+        def _do():
+            ent = self._migrations.pop(rid, None)
+            if ent is None:
+                return False
+            rt, handle = ent
+            req = handle["req"]
+            try:
+                rt.release_export(handle)
+            except Exception:  # noqa: BLE001 — state release must not wedge
+                log.exception("release of migrated slot failed (%s)",
+                              getattr(rt, "name", "?"))
+            if not commit:
+                self.journal.record("migrate_abort", req=req,
+                                    why=why or "transfer_failed")
+            self.core.mark_dropped(req.user)
+            # The finish carries the freed slot so the journal's
+            # slot-occupancy story stays consistent: the next install
+            # into this slot is a reuse, not a double-assignment.
+            extra = ({"slot": handle["slot"]} if "slot" in handle else {})
+            self.journal.record("finish", req=req, reason="cancelled",
+                                tokens=len(req.generated_ids),
+                                model=getattr(rt, "name", None), **extra)
+            req.finish(FinishReason.CANCELLED)
+            self.notify()
+            return True
+
+        return self.call_on_loop(_do)
+
+    def import_stream(self, blob: dict, ip: str = "", family=None,
+                      deadline: Optional[float] = None) -> Request:
+        """Target side of a migration: rebuild the Request and install
+        it DIRECTLY into a decode slot from the shipped pages — no
+        queue wait, no re-prefill. Raises MigrationError when it cannot
+        land (caller falls back to recompute). Bypasses bounded
+        admission like inject_request: the router already admitted."""
+        state = blob.get("request") or {}
+        if not state.get("user"):
+            raise MigrationError("malformed migration blob (no request)")
+
+        def _do():
+            rid = self.core.enqueue(
+                state["user"], ip, state.get("model"),
+                family if family is not None else Family.UNKNOWN)
+            # The id is all we need — the stream never waits in this
+            # member's queue (it resumes mid-decode), so take the queue
+            # entry straight back out and count it started instead.
+            self.core.cancel(rid)
+            req = request_from_migration_state(rid, state)
+            req._inc_decode = blob.get("_inc_decode")
+            req.deadline = deadline
+            rt = self.resolve_runtime(state.get("model"), kind="generate")
+            if rt is None:
+                raise MigrationError(
+                    f"model not loaded: {state.get('model')}")
+            reps = rt.replicas if isinstance(rt, ReplicaSet) else [rt]
+            for rep in reps:
+                import_fn = getattr(rep, "import_request", None)
+                if import_fn is not None and import_fn(blob, req):
+                    break
+            else:
+                raise MigrationError("no slot/pages for migrated stream")
+            self.core.mark_started(req.user)
+            req.started = True
+            self.journal.record(
+                "migrate_import", req=req, tokens=len(req.generated_ids),
+                pages=blob.get("n_pages"))
+            self.notify()
+            return req
+
+        return self.call_on_loop(_do)
+
+    def export_prefix(self, model: str, tokens) -> Optional[dict]:
+        """Affinity-miss prefix shipping, source side (router seam)."""
+        def _do():
+            rt = self.resolve_runtime(model)
+            if rt is None:
+                return None
+            reps = rt.replicas if isinstance(rt, ReplicaSet) else [rt]
+            for rep in reps:
+                fn = getattr(rep, "export_prefix", None)
+                if fn is not None:
+                    blob = fn(list(tokens))
+                    if blob is not None:
+                        return blob
+            return None
+
+        try:
+            return self.call_on_loop(_do, timeout=10.0)
+        except TimeoutError:
+            return None
+
+    def import_prefix(self, model: str, blob: dict) -> int:
+        """Affinity-miss prefix shipping, target side: pages adopted."""
+        def _do():
+            rt = self.resolve_runtime(model)
+            if rt is None:
+                return 0
+            reps = rt.replicas if isinstance(rt, ReplicaSet) else [rt]
+            for rep in reps:
+                fn = getattr(rep, "import_prefix", None)
+                if fn is not None:
+                    n = fn(blob)
+                    if n:
+                        return n
+            return 0
+
+        try:
+            return self.call_on_loop(_do, timeout=10.0)
+        except TimeoutError:
+            return 0
 
     def _count_shed(self, reason: str) -> None:
         tm.SHED_TOTAL.labels(reason=reason).inc()
@@ -3521,6 +3931,13 @@ class TPUEngine:
                 req.finish(FinishReason.CANCELLED)
             self.notify()
             return
+        if req is None:
+            # Mid-migration: the request is detached from every slot but
+            # still parked in the two-phase handoff table.
+            for _rt, handle in self._migrations.values():
+                if handle["req"].req_id == req_id:
+                    req = handle["req"]
+                    break
         if req is None:
             # Already admitted: find it in a runtime (active slot or
             # waiting for prefill). _step_targets flattens replica sets —

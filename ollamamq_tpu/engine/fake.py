@@ -231,7 +231,8 @@ class FakeRuntime:
                     self._finish_served(req, core, FinishReason.STOP)
                     break
                 if chunk:
-                    req.stream.push(StreamItem("token", text=chunk))
+                    req.stream.push(StreamItem("token", text=chunk,
+                                               token_id=req._fake_idx))
                 if req._fake_remaining <= 0:
                     self.active.remove(req)
                     tail = req.flush_text()
@@ -239,6 +240,42 @@ class FakeRuntime:
                         req.stream.push(StreamItem("token", text=tail))
                     self._finish_served(req, core, FinishReason.LENGTH)
                     break
+
+    # -- KV page migration (fake shape: no pages, just the word cursor) ----
+    def export_request(self, rid: int):
+        """Same export contract as ModelRuntime, fake state: the word
+        cursor IS the KV. Lets fleet drain/failover exercise the full
+        two-phase migration path without jax."""
+        from ollamamq_tpu.engine.engine import request_migration_state
+
+        for req in self.active:
+            if req.req_id == rid:
+                break
+        else:
+            return None
+        blob = {
+            "version": 1, "kind": "fake", "model": self.name,
+            "fake_idx": int(req._fake_idx),
+            "fake_remaining": int(req._fake_remaining),
+            "request": request_migration_state(req),
+            "_inc_decode": req._inc_decode,
+        }
+        self.active.remove(req)
+        return {"req": req}, blob
+
+    def release_export(self, handle: dict) -> None:
+        pass  # fakes hold no pages to free
+
+    def import_request(self, blob: dict, req: Request) -> bool:
+        if blob.get("kind") != "fake" \
+                or len(self.active) >= self.ecfg.max_slots:
+            return False
+        req._fake_idx = int(blob["fake_idx"])
+        req._fake_remaining = int(blob["fake_remaining"])
+        self._jrec("install", req, slot=-1,
+                   n_prompt=len(req.prompt_tokens))
+        self.active.append(req)
+        return True
 
     def _fake_embedding(self, req: Request) -> list:
         # Deterministic unit vector derived from the prompt bytes.
@@ -304,6 +341,9 @@ class FakeEngine(TPUEngine):
         while self._running:
             self.last_tick_at = time.monotonic()
             self.journal.tick += 1
+            # Deferred engine-thread calls (the fleet's migration
+            # export/import run through call_on_loop here too).
+            self._drain_engine_calls()
             self._admit()
             did_work = False
             for rt in list(self.runtimes.values()):

@@ -17,6 +17,9 @@ reference dropping a disconnected client's stream
 
 from __future__ import annotations
 
+import io
+import json
+import struct
 from typing import List, Optional
 
 import jax
@@ -185,6 +188,129 @@ def kv_pool_bytes(model_cfg: ModelConfig, engine_cfg: EngineConfig,
         * model_cfg.num_kv_heads
         * per_tok_head
     )
+
+
+# ---------------------------------------------------------------------------
+# KV page migration: extract a sequence's page run from the pool into a
+# portable host-side blob (and write one back at new page indices), plus
+# a self-describing wire format so the blob can cross a process boundary
+# (fleet HttpMember /admin/migrate). int8 pools move the int8 payload +
+# fp32 scale rows — ~2x cheaper on the wire than bf16 pages.
+# ---------------------------------------------------------------------------
+
+_WIRE_MAGIC = b"OMQMIG1\n"
+
+
+def _page_index(pages: List[int], page_size: int) -> np.ndarray:
+    """Slot-pool row indices covering `pages` in run order."""
+    idx = np.empty((len(pages) * page_size,), np.int32)
+    for i, p in enumerate(pages):
+        idx[i * page_size:(i + 1) * page_size] = np.arange(
+            p * page_size, (p + 1) * page_size, dtype=np.int32)
+    return idx
+
+
+def gather_page_run(kc, vc, pages: List[int], page_size: int) -> dict:
+    """Copy a page run's K/V data to host numpy arrays. Returns
+    {"k_pages", "v_pages"} shaped [n_pages*page_size, ...] sliced along
+    the pool's slot axis (axis 1), plus {"k_scale", "v_scale"} for
+    quantized pools. Read-only with respect to the pool."""
+    from ollamamq_tpu.ops.quant import QuantKV
+
+    idx = jnp.asarray(_page_index(pages, page_size))
+    if isinstance(kc, QuantKV):
+        return {
+            "k_pages": np.asarray(jnp.take(kc.q, idx, axis=1)),
+            "v_pages": np.asarray(jnp.take(vc.q, idx, axis=1)),
+            "k_scale": np.asarray(jnp.take(kc.s, idx, axis=1)),
+            "v_scale": np.asarray(jnp.take(vc.s, idx, axis=1)),
+        }
+    return {
+        "k_pages": np.asarray(jnp.take(kc, idx, axis=1)),
+        "v_pages": np.asarray(jnp.take(vc, idx, axis=1)),
+    }
+
+
+def scatter_page_run(kc, vc, pages: List[int], page_size: int, data: dict):
+    """Write a gathered page run back into a (possibly different) pool at
+    `pages`. Returns the updated (kc, vc) — functional update, caller
+    reassigns."""
+    from ollamamq_tpu.ops.quant import QuantKV
+
+    idx = jnp.asarray(_page_index(pages, page_size))
+    if isinstance(kc, QuantKV):
+        k = QuantKV(kc.q.at[:, idx].set(jnp.asarray(data["k_pages"])),
+                    kc.s.at[:, idx].set(jnp.asarray(data["k_scale"])))
+        v = QuantKV(vc.q.at[:, idx].set(jnp.asarray(data["v_pages"])),
+                    vc.s.at[:, idx].set(jnp.asarray(data["v_scale"])))
+        return k, v
+    k = kc.at[:, idx].set(jnp.asarray(data["k_pages"], dtype=kc.dtype))
+    v = vc.at[:, idx].set(jnp.asarray(data["v_pages"], dtype=vc.dtype))
+    return k, v
+
+
+def migration_blob_bytes(blob: dict) -> int:
+    """Approximate wire size of a blob (the payload arrays dominate) —
+    the ollamamq_fleet_migrate_bytes_total accounting unit."""
+    return sum(v.nbytes for v in blob.values()
+               if isinstance(v, np.ndarray))
+
+
+def pack_migration_blob(blob: dict) -> bytes:
+    """Serialize a migration blob for the wire: magic + length-prefixed
+    JSON header (scalars/lists) + an npz of the numpy arrays. Keys
+    starting with "_" are in-process-only state (e.g. a live incremental
+    detokenizer) and are dropped — the unpacker reconstructs them.
+
+    Non-native dtypes (bfloat16 and friends come from ml_dtypes, which
+    npz cannot round-trip) ship as raw uint8 byte views with the true
+    dtype name recorded in the header."""
+    header, arrays, exotic = {}, {}, {}
+    for key, val in blob.items():
+        if key.startswith("_"):
+            continue
+        if isinstance(val, np.ndarray):
+            if val.dtype.kind not in "biufc":
+                exotic[key] = val.dtype.name
+                val = np.ascontiguousarray(val).view(np.uint8)
+            arrays[key] = val
+        else:
+            header[key] = val
+    if exotic:
+        header["wire_dtypes"] = exotic
+    hdr = json.dumps(header).encode()
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return _WIRE_MAGIC + struct.pack(">I", len(hdr)) + hdr + buf.getvalue()
+
+
+def unpack_migration_blob(raw: bytes) -> dict:
+    """Inverse of pack_migration_blob. Raises ValueError on a foreign or
+    truncated payload (the import endpoint turns that into a 400)."""
+    if not raw.startswith(_WIRE_MAGIC):
+        raise ValueError("not a migration blob (bad magic)")
+    off = len(_WIRE_MAGIC)
+    if len(raw) < off + 4:
+        raise ValueError("truncated migration blob header")
+    (hlen,) = struct.unpack(">I", raw[off:off + 4])
+    off += 4
+    try:
+        blob = json.loads(raw[off:off + hlen])
+    except json.JSONDecodeError as e:
+        raise ValueError(f"corrupt migration blob header: {e}")
+    with np.load(io.BytesIO(raw[off + hlen:]), allow_pickle=False) as npz:
+        for key in npz.files:
+            blob[key] = npz[key]
+    for key, name in (blob.pop("wire_dtypes", None) or {}).items():
+        try:
+            import ml_dtypes
+
+            dt = np.dtype(getattr(ml_dtypes, name))
+        except (AttributeError, ImportError, TypeError) as e:
+            raise ValueError(f"unknown wire dtype {name!r}: {e}")
+        if key in blob:
+            blob[key] = blob[key].view(dt)
+    return blob
 
 
 def kv_page_bytes(model_cfg: ModelConfig, page_size: int,

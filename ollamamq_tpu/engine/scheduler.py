@@ -105,6 +105,25 @@ class OutputLenPredictor:
             self._window.append((int(predicted), actual))
         self.observed += 1
 
+    def export_user(self, user: str) -> dict:
+        """One user's predictor state for a KV migration blob: the
+        target member's predictor shouldn't cold-start a user the fleet
+        already learned."""
+        return {"user_ema": self._user.get(user),
+                "global_ema": self._global, "ratio_ema": self._ratio}
+
+    def import_user(self, user: str, state: dict) -> None:
+        """Merge a migrated user's predictor state: never clobber what
+        this member already observed locally — migration fills gaps, it
+        doesn't overwrite evidence."""
+        ue = state.get("user_ema")
+        if ue is not None and user not in self._user:
+            self._user[user] = float(ue)
+        if self._global is None and state.get("global_ema") is not None:
+            self._global = float(state["global_ema"])
+        if self._ratio is None and state.get("ratio_ema") is not None:
+            self._ratio = float(state["ratio_ema"])
+
     def accuracy(self) -> Optional[float]:
         """Mean relative accuracy (1 - |pred - actual| / max(actual, 1))
         over the recent window, clamped to [0, 1]. None before warmup —

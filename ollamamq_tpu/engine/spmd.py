@@ -600,6 +600,31 @@ class SPMDModelRuntime(ModelRuntime):
         if not self._spmd:
             super()._fault(site)
 
+    def export_request(self, rid):
+        # KV migration is a fleet-member feature; on a multi-host SPMD
+        # runtime the pool gather/scatter would run primary-only and
+        # desync worker replay state. Single-process behaves like
+        # ModelRuntime (the fleet CLI already forbids --replicas+--spmd;
+        # this guards the bare /admin/migrate surface too).
+        if self._spmd:
+            return None
+        return super().export_request(rid)
+
+    def import_request(self, blob, req):
+        if self._spmd:
+            return False
+        return super().import_request(blob, req)
+
+    def export_prefix(self, tokens):
+        if self._spmd:
+            return None
+        return super().export_prefix(tokens)
+
+    def import_prefix(self, blob):
+        if self._spmd:
+            return 0
+        return super().import_prefix(blob)
+
     def _dispatch_prefill(self, bucket, B, tokens, lens, slot_ids, pt_rows,
                           temp, tk, tp, pen, pres, freq, seeds, key):
         if not self._spmd:

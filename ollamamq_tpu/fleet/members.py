@@ -53,7 +53,8 @@ class Attempt:
 
     __slots__ = ("req", "member", "acked", "closed", "transport_dead",
                  "base_n", "n_items", "text_mode", "prior_text",
-                 "text_parts", "thread", "resp", "embedding_val")
+                 "text_parts", "thread", "resp", "embedding_val",
+                 "member_rid", "token_ids", "prior_ids", "context_ids")
 
     def __init__(self, req: Request, member) -> None:
         self.req = req
@@ -69,9 +70,20 @@ class Attempt:
         self.thread: Optional[threading.Thread] = None
         self.resp = None
         self.embedding_val = None
+        # HTTP attempts: the member-side request id (read off the NDJSON
+        # frames; rotates with member-side requeues) — the handle the
+        # /admin/migrate endpoints key on — plus the token ids the
+        # frames carried, so resumed HTTP streams replay in TOKEN space
+        # (verified token-identical) instead of re-tokenized text.
+        self.member_rid: Optional[int] = None
+        self.token_ids: list = []
+        self.prior_ids: Optional[list] = None  # ids of PRIOR attempts
+        self.context_ids: Optional[list] = None  # token-space HTTP replay
 
     def tokens_done(self) -> int:
         if self.text_mode:
+            if self.prior_ids is not None and self.token_ids:
+                return len(self.prior_ids) + len(self.token_ids)
             return self.base_n + self.n_items
         return len(self.req.generated_ids)
 
@@ -86,9 +98,20 @@ class Attempt:
         healthy replica needs to continue it seamlessly."""
         req = self.req
         if self.text_mode:
+            text = self.prior_text + "".join(self.text_parts)
+            # Token-space HTTP resume: when every attempt so far carried
+            # its token ids on the wire, the next attempt replays exact
+            # ids (byte-identical continuation, verified token-identical)
+            # instead of re-tokenizing emitted text.
+            if self.prior_ids is not None \
+                    and (self.n_items == 0 or self.token_ids):
+                gen = list(self.prior_ids) + [int(t) for t in
+                                              self.token_ids]
+                return {"gen_ids": gen, "n_gen": len(gen), "inc": None,
+                        "detok": text, "emitted": len(text), "text": text}
             return {"gen_ids": None,
                     "n_gen": self.base_n + self.n_items,
-                    "text": self.prior_text + "".join(self.text_parts)}
+                    "text": text}
         return {"gen_ids": list(req.generated_ids),
                 "n_gen": len(req.generated_ids),
                 "inc": req._inc_decode,
@@ -271,6 +294,40 @@ class LocalMember(_MemberBase):
         except Exception:  # noqa: BLE001 — a dead member must not block evac
             log.exception("cancel on member %s failed", self.name)
 
+    # -- KV page migration (in-process handoff) ----------------------------
+    def export_stream(self, att: Attempt,
+                      deadline: Optional[float] = None):
+        """Phase 1: detach the attempt's decode slot into a blob. Works
+        even on a member whose loop just died (a crashed engine's state
+        is frozen, not gone — exactly when migration beats recompute).
+        None = not exportable; the router falls back to recompute."""
+        return self.engine.export_stream(att.req.req_id, deadline)
+
+    def resolve_export(self, att: Attempt, commit: bool,
+                       why: str = "") -> None:
+        """Phase 2: release the parked source state (commit after the
+        target acked the import, abort otherwise)."""
+        self.engine.resolve_export(att.req.req_id, commit=commit, why=why)
+
+    def import_stream(self, blob: dict, flight, on_item=None) -> Attempt:
+        """Target side: land the shipped state straight into a decode
+        slot (raises MigrationError when it cannot — the ack the source
+        commit waits on is this returning)."""
+        req = self.engine.import_stream(
+            blob, ip=flight.ip, family=flight.family,
+            deadline=flight.req.deadline)
+        if on_item is not None:
+            req.stream.on_item = on_item
+        return Attempt(req, self)
+
+    def export_prefix(self, model: str, tokens):
+        fn = getattr(self.engine, "export_prefix", None)
+        return fn(model, tokens) if fn is not None else None
+
+    def import_prefix(self, model: str, blob: dict) -> int:
+        fn = getattr(self.engine, "import_prefix", None)
+        return fn(model, blob) if fn is not None else 0
+
 
 class HttpMember(_MemberBase):
     """A remote engine replica speaking the existing HTTP API. Health is
@@ -359,15 +416,28 @@ class HttpMember(_MemberBase):
     def begin(self, flight, resume: Optional[dict], on_item=None) -> Attempt:
         n_prior = int(resume.get("n_gen", 0)) if resume else 0
         prior_text = resume.get("text", "") if resume else ""
+        gen_ids = resume.get("gen_ids") if resume else None
+        if gen_ids is not None:
+            # Token-space resume: the already-emitted ids ride the wire
+            # as Ollama's `context` field — the member re-prefills
+            # prompt + exact ids and continues, so greedy resumed HTTP
+            # streams are token-identical, not re-tokenized best-effort.
+            raw_prompt = flight.raw_prompt
+        else:
+            raw_prompt = flight.raw_prompt + prior_text
         req = Request(0, flight.user, flight.model, [], flight.sampling,
-                      kind=flight.kind,
-                      raw_prompt=flight.raw_prompt + prior_text)
+                      kind=flight.kind, raw_prompt=raw_prompt)
         if on_item is not None:
             req.stream.on_item = on_item
         att = Attempt(req, self)
         att.text_mode = True
         att.base_n = n_prior
         att.prior_text = prior_text
+        if gen_ids is not None:
+            att.context_ids = [int(t) for t in gen_ids]
+            att.prior_ids = list(att.context_ids)
+        elif resume is None:
+            att.prior_ids = []  # fresh stream: the frames' ids are all
         att.thread = threading.Thread(
             target=self._reader, args=(att, flight, n_prior),
             name=f"fleet-{self.name}-r{flight.rid0}", daemon=True)
@@ -395,10 +465,12 @@ class HttpMember(_MemberBase):
         items into the attempt stream. A transport failure pushes
         NOTHING terminal: a dead connection is the failover trigger, not
         a client-visible error — the router notices transport_dead and
-        re-dispatches the stream."""
+        re-dispatches the stream. When `att.resp` is already open (a
+        migration import whose status line WAS the ack) this only
+        consumes the body."""
         stream = att.req.stream
         try:
-            if flight.kind == "embed":
+            if att.resp is None and flight.kind == "embed":
                 body = {"model": flight.model, "input": flight.raw_prompt}
                 httpreq = urllib.request.Request(
                     self.url + "/api/embed",
@@ -412,19 +484,24 @@ class HttpMember(_MemberBase):
                 att.embedding_val = vecs[0] if vecs else []
                 stream.push(StreamItem("done", finish_reason=FinishReason.STOP))
                 return
-            remaining = max(1, flight.sampling.max_tokens - n_prior)
-            body = {"model": flight.model, "prompt": att.req.raw_prompt,
-                    "stream": True,
-                    "options": self._options(flight.sampling, remaining)}
-            headers = {"Content-Type": "application/json",
-                       "X-User-ID": flight.user}
-            if flight.req.deadline is not None:
-                left_ms = (flight.req.deadline - time.monotonic()) * 1e3
-                headers["X-Deadline-Ms"] = str(max(1.0, left_ms))
-            httpreq = urllib.request.Request(
-                self.url + "/api/generate", data=json.dumps(body).encode(),
-                headers=headers, method="POST")
-            att.resp = urllib.request.urlopen(httpreq, timeout=self.timeout_s)
+            if att.resp is None:
+                remaining = max(1, flight.sampling.max_tokens - n_prior)
+                body = {"model": flight.model, "prompt": att.req.raw_prompt,
+                        "stream": True,
+                        "options": self._options(flight.sampling, remaining)}
+                if att.context_ids is not None:
+                    body["context"] = att.context_ids
+                headers = {"Content-Type": "application/json",
+                           "X-User-ID": flight.user}
+                if flight.req.deadline is not None:
+                    left_ms = (flight.req.deadline - time.monotonic()) * 1e3
+                    headers["X-Deadline-Ms"] = str(max(1.0, left_ms))
+                httpreq = urllib.request.Request(
+                    self.url + "/api/generate",
+                    data=json.dumps(body).encode(),
+                    headers=headers, method="POST")
+                att.resp = urllib.request.urlopen(httpreq,
+                                                  timeout=self.timeout_s)
             for raw in att.resp:
                 if att.closed:
                     return
@@ -432,17 +509,25 @@ class HttpMember(_MemberBase):
                     obj = json.loads(raw)
                 except json.JSONDecodeError:
                     continue
+                if obj.get("req_id") is not None:
+                    # The member-side id: the migration-export handle
+                    # (tracked live — member-side requeues rotate it).
+                    att.member_rid = int(obj["req_id"])
                 if obj.get("error"):
                     reason = _REASONS.get(obj.get("done_reason", ""),
                                           FinishReason.ERROR)
                     stream.push(StreamItem("error", finish_reason=reason,
                                            error=str(obj["error"])))
                     return
+                ids = obj.get("token_ids") or ()
+                att.token_ids.extend(int(t) for t in ids)
                 txt = obj.get("response", "")
                 if txt:
                     att.n_items += 1
                     att.text_parts.append(txt)
-                    stream.push(StreamItem("token", text=txt))
+                    stream.push(StreamItem(
+                        "token", text=txt,
+                        token_id=int(ids[0]) if len(ids) == 1 else -1))
                 if obj.get("done"):
                     reason = _REASONS.get(obj.get("done_reason", "stop"),
                                           FinishReason.STOP)
@@ -471,3 +556,80 @@ class HttpMember(_MemberBase):
                 resp.close()  # member sees the disconnect and cancels
             except Exception:  # noqa: BLE001
                 pass
+
+    # -- KV page migration (/admin/migrate wire) ---------------------------
+    def _post_json(self, path: str, body: dict, timeout: float):
+        httpreq = urllib.request.Request(
+            self.url + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        return urllib.request.urlopen(httpreq, timeout=timeout)
+
+    def export_stream(self, att: Attempt,
+                      deadline: Optional[float] = None):
+        """Phase 1 over the wire: ask the member service to snapshot +
+        park the stream's decode slot, keyed by the member-side request
+        id the NDJSON frames carried. None = not exportable (unknown id,
+        member unreachable, nothing installed) — recompute fallback."""
+        if att.member_rid is None:
+            return None
+        from ollamamq_tpu.engine import kv_cache as kvc
+
+        left = (deadline - time.monotonic() if deadline is not None
+                else 10.0)
+        if left <= 0.05:
+            return None
+        try:
+            with self._post_json(
+                    "/admin/migrate/export",
+                    {"req_id": att.member_rid, "timeout_s": left},
+                    timeout=left) as resp:
+                return kvc.unpack_migration_blob(resp.read())
+        except Exception:  # noqa: BLE001 — export failure means fallback
+            return None
+
+    def resolve_export(self, att: Attempt, commit: bool,
+                       why: str = "") -> None:
+        if att.member_rid is None:
+            return
+        path = "/admin/migrate/" + ("commit" if commit else "abort")
+        try:
+            self._post_json(path, {"req_id": att.member_rid, "why": why},
+                            timeout=5.0).close()
+        except Exception:  # noqa: BLE001 — a dead source resolves itself
+            pass
+
+    def import_stream(self, blob: dict, flight, on_item=None) -> Attempt:
+        """Target side over the wire: POST the packed blob; a 2xx status
+        line IS the import ack (the member installs the slot before it
+        starts streaming), then the continuation rides the same NDJSON
+        reader as a normal stream. Raises on any failure so the router
+        aborts the handoff and falls back to recompute."""
+        from ollamamq_tpu.engine import kv_cache as kvc
+
+        state = blob.get("request") or {}
+        gen = [int(t) for t in state.get("generated_ids", ())]
+        req = Request(0, flight.user, flight.model, [], flight.sampling,
+                      kind=flight.kind, raw_prompt=flight.raw_prompt)
+        if on_item is not None:
+            req.stream.on_item = on_item
+        att = Attempt(req, self)
+        att.text_mode = True
+        att.base_n = len(gen)
+        att.prior_ids = gen
+        att.prior_text = state.get("detok_text",
+                                   "")[:int(state.get("emitted_len", 0))]
+        headers = {"Content-Type": "application/octet-stream",
+                   "X-User-ID": flight.user}
+        if flight.req.deadline is not None:
+            left_ms = (flight.req.deadline - time.monotonic()) * 1e3
+            headers["X-Deadline-Ms"] = str(max(1.0, left_ms))
+        httpreq = urllib.request.Request(
+            self.url + "/admin/migrate/import",
+            data=kvc.pack_migration_blob(blob), headers=headers,
+            method="POST")
+        att.resp = urllib.request.urlopen(httpreq, timeout=self.timeout_s)
+        att.thread = threading.Thread(
+            target=self._reader, args=(att, flight, att.base_n),
+            name=f"fleet-{self.name}-m{flight.rid0}", daemon=True)
+        att.thread.start()
+        return att

@@ -15,14 +15,20 @@ placement). This module is that role over N engine replicas:
     staleness; an unhealthy member is EJECTED from rotation and
     re-probed with exponential backoff before re-admission;
   - when a replica dies or is ejected mid-stream, its victim streams
-    FAIL OVER: the router replays prompt + every already-emitted token
-    on a healthy replica (the PR-4 preemption/replay semantics lifted
-    to fleet level) so the client sees one seamless stream — greedy
-    streams are byte-identical to an unkilled run on local members;
+    recover by MIGRATION first: the dying member's KV pages + request
+    state ship to a healthy member in a journaled two-phase handoff
+    (export/park -> import ack -> commit), so the stream resumes from
+    shipped state with ZERO recomputed tokens. Only when the source
+    can't export (or the transfer fails) does the stream FAIL OVER the
+    PR-9 way: replay prompt + every already-emitted token on a healthy
+    replica. Both paths keep greedy streams byte-identical to an
+    unkilled run;
   - POST /admin/drain/{replica} quiesces a member: no new placements,
-    in-flight streams run to completion (stragglers past the drain
-    timeout fail over), then hot-restart and rejoin — rolling restarts
-    drop nothing.
+    live streams MIGRATE to healthy members (stragglers that can't
+    migrate run to completion, failing over past the drain timeout),
+    then hot-restart and rejoin — rolling restarts drop nothing;
+  - affinity misses may ship the cached prompt prefix to the chosen
+    member instead of routing around it.
 
 Every fleet decision is journaled (replica_eject / replica_failover /
 replica_drain / replica_join) with the inputs that justified it, under
@@ -37,6 +43,7 @@ tracer / health ...), so server/app.py serves a fleet unchanged.
 
 from __future__ import annotations
 
+import copy
 import logging
 import threading
 import time
@@ -44,6 +51,7 @@ from typing import Dict, List, Optional
 
 from ollamamq_tpu.core import Fairness, MQCore
 from ollamamq_tpu.core.mqcore import BlockedError, Family, StuckQueue
+from ollamamq_tpu.engine import kv_cache as kvc
 from ollamamq_tpu.engine.engine import QueueFullError
 from ollamamq_tpu.engine.request import FinishReason, Request
 from ollamamq_tpu.fleet.members import HttpMember, LocalMember  # noqa: F401
@@ -71,7 +79,8 @@ class _Flight:
     __slots__ = ("req", "rid0", "user", "ip", "model", "family", "kind",
                  "raw_prompt", "prompt_tokens", "sampling", "member",
                  "attempt", "resume", "failed_from", "evac_since",
-                 "evac_deadline", "begin_failures", "done")
+                 "evac_deadline", "begin_failures", "done",
+                 "migrate_tried")
 
     def __init__(self, req: Request, ip: str, family) -> None:
         self.req = req
@@ -92,6 +101,7 @@ class _Flight:
         self.evac_deadline = 0.0
         self.begin_failures = 0
         self.done = False
+        self.migrate_tried = False  # one migration attempt per drain
 
 
 class FleetRouter:
@@ -105,7 +115,9 @@ class FleetRouter:
                  probe_period_s: float = PROBE_PERIOD_S,
                  eject_heartbeat_s: float = EJECT_HEARTBEAT_S,
                  reprobe_backoff_s: float = REPROBE_BACKOFF_S,
-                 evac_grace_s: float = EVAC_GRACE_S):
+                 evac_grace_s: float = EVAC_GRACE_S,
+                 migrate: Optional[bool] = None,
+                 migrate_timeout_s: Optional[float] = None):
         assert members, "a fleet needs at least one member"
         if placement not in ("affinity", "least_loaded"):
             raise ValueError(f"unknown placement policy {placement!r} "
@@ -120,6 +132,15 @@ class FleetRouter:
         self.eject_heartbeat_s = float(eject_heartbeat_s)
         self.reprobe_backoff_s = float(reprobe_backoff_s)
         self.evac_grace_s = float(evac_grace_s)
+        # KV page migration: failover/drain ships state instead of
+        # recomputing it (falling back to recompute when it can't).
+        self.migrate = bool(getattr(engine_cfg, "migrate", True)
+                            if migrate is None else migrate)
+        self.migrate_timeout_s = float(
+            getattr(engine_cfg, "migrate_timeout_s", 10.0)
+            if migrate_timeout_s is None else migrate_timeout_s)
+        self.migration_count = 0
+        self.migrate_abort_count = 0
         self.core = MQCore(blocklist_path)
         self.core.set_fairness(fairness)
         self.pending: Dict[int, _Flight] = {}  # queued, keyed by CURRENT rid
@@ -324,10 +345,13 @@ class FleetRouter:
     def enqueue_request(self, user: str, ip: str, model: str, family=None,
                         prompt_tokens=None, sampling=None,
                         kind: str = "generate",
-                        raw_prompt: str = "") -> Request:
+                        raw_prompt: str = "",
+                        context_ids=None) -> Request:
         """Fleet-wide bounded admission + fair-share enqueue. Mirrors
         TPUEngine.enqueue_request; the caps apply to the ROUTER queue
-        (members run uncapped — the router already admitted)."""
+        (members run uncapped — the router already admitted).
+        `context_ids` (Ollama `context`) seeds the flight's resume state
+        so the first placement already replays in token space."""
         cfg = self.ecfg
         if cfg.max_queued and self.core.total_queued() >= cfg.max_queued:
             self._count_shed("queue_full")
@@ -358,9 +382,23 @@ class FleetRouter:
                 family if family is not None else Family.UNKNOWN, kind=kind)
             req = Request(rid, user, model, prompt_tokens or [], sampling,
                           kind=kind, raw_prompt=raw_prompt)
+            if context_ids:
+                # Prior-turn ids: widen the budget (max_tokens buys NEW
+                # tokens) and dispatch as a token-space resume.
+                ctx = [int(t) for t in context_ids]
+                sp = copy.copy(req.sampling)  # skip __post_init__ refold
+                sp.max_tokens = sp.max_tokens + len(ctx)
+                req.sampling = sp
+                req.generated_ids = list(ctx)
+                req._replay_gen = len(ctx)
             req.trace = self.tracer.begin(rid, user, model, kind=kind)
             flight = _Flight(req, ip, family if family is not None
                              else Family.UNKNOWN)
+            if context_ids:
+                flight.resume = {"gen_ids": list(req.generated_ids),
+                                 "n_gen": len(req.generated_ids),
+                                 "inc": None, "detok": "", "emitted": 0,
+                                 "text": ""}
             self.pending[rid] = flight
         self.journal.record(
             "enqueue", req_id=flight.rid0, user=user, model=model or None,
@@ -409,9 +447,13 @@ class FleetRouter:
         self.last_tick_at = time.monotonic()
         self.journal.tick += 1
         self._probe()
+        # Drain BEFORE admission: a draining member's migrating streams
+        # get first claim on slots other members just freed — fresh
+        # placements must not starve the evacuation that unblocks the
+        # rolling restart.
+        self._drain_progress()
         self._admit()
         did_work = self._pump()
-        self._drain_progress()
         if not did_work:
             with self._cond:
                 self._cond.wait(timeout=0.02)
@@ -512,6 +554,7 @@ class FleetRouter:
                 # wait-in-queue, FIFO preserved.
                 self._requeue(flight, why="unplaceable")
                 break
+            self._maybe_ship_prefix(flight, mem)
             if self._dispatch(flight, mem):
                 placed += 1
         return placed
@@ -609,19 +652,24 @@ class FleetRouter:
                 return True
         if att.transport_dead and flight.evac_since is None:
             # The member's HTTP stream died under this one request while
-            # the member itself still looks healthy: fail over just this
-            # stream.
-            self._begin_evac(flight)
+            # the member itself still looks healthy: try to migrate just
+            # this stream (the member may still serve /admin/migrate),
+            # else fail it over via recompute replay.
+            if self._try_migrate(flight, flight.member,
+                                 why="transport") != "migrated":
+                self._begin_evac(flight)
             did = True
         return did
 
     def _forward_token(self, flight: _Flight, item) -> None:
-        if not item.text:
+        if not item.text and item.token_id < 0:
             return
-        if not flight.req.stats.first_token_at:
+        if item.text and not flight.req.stats.first_token_at:
             flight.req.stats.first_token_at = time.monotonic()
             flight.req.trace_event(
                 "first_token", ttft_ms=round(flight.req.stats.ttft_ms, 3))
+        # Empty-text items still forward: they carry the sampled token
+        # ids the NDJSON writer folds into the next written frame.
         flight.req.stream.push(item)
 
     def _finish_from_item(self, flight: _Flight, item) -> None:
@@ -667,6 +715,203 @@ class FleetRouter:
         if att is not None and mem is not None and not att.closed:
             mem.cancel(att)
         self._finish(flight, FinishReason.CANCELLED)
+
+    # ------------------------------------------------------------- migration
+    def _choose_migration_target(self, flight: _Flight, source):
+        """Healthy member to receive a shipped stream: least-loaded
+        among those that can take the model and speak import."""
+        elig = [m for m in self.members
+                if m is not source
+                and getattr(m, "import_stream", None) is not None
+                and self._can_place(m, flight.model, "generate")]
+        if not elig:
+            return None
+        return min(elig, key=self._load_of)
+
+    def _try_migrate(self, flight: _Flight, source, why: str) -> str:
+        """Two-phase KV handoff of one live stream off `source`: export
+        (snapshot + park the source slot), ship, import (the target's
+        ack), then commit the source release. Journaled at every phase
+        under the stream's stable rid0 so the no-dropped-streams audit
+        can pair each export with its import or abort.
+
+        Returns "migrated" (the stream now lives on the target),
+        "intact" (nothing was exported — the source stream is untouched
+        and may keep serving), or "aborted" (the export happened but the
+        transfer failed: the parked source state is RELEASED, so the
+        caller MUST recover the stream via the PR-9 recompute replay —
+        migration is an optimization, recompute is the guarantee)."""
+        if not self.migrate or flight.kind != "generate":
+            return "intact"
+        att = flight.attempt
+        if att is None or att.closed \
+                or getattr(source, "export_stream", None) is None:
+            return "intact"
+        # Target first: exporting detaches the source slot, so never
+        # start a handoff nobody can receive (a full fleet would turn
+        # every drain attempt into a pointless abort+recompute).
+        if self._choose_migration_target(flight, source) is None:
+            return "intact"
+        deadline = time.monotonic() + self.migrate_timeout_s
+        try:
+            blob = source.export_stream(att, deadline)
+        except Exception:  # noqa: BLE001 — unexportable => recompute
+            log.exception("migration export of req %d from %s failed",
+                          flight.rid0, source.name)
+            blob = None
+        if blob is None:
+            return "intact"
+        nbytes = kvc.migration_blob_bytes(blob)
+        state = blob.get("request") or {}
+        n_gen = len(state.get("generated_ids") or ())
+        self.journal.record(
+            "migrate_export", req_id=flight.rid0, user=flight.user,
+            model=flight.model or None, replica=source.name,
+            tokens=n_gen, kv_len=blob.get("kv_len"),
+            pages=blob.get("n_pages"), bytes=nbytes)
+        abort_why = None
+        # Fault site "migrate": chaos kills the transfer at every phase
+        # of the handoff — mid-flight failure, a stall past the budget,
+        # source death after export.
+        if self.fault_plan is not None:
+            try:
+                fired = self.fault_plan.draw("migrate")
+            except Exception:  # noqa: BLE001
+                log.exception("fault-plan draw failed")
+                fired = []
+            for kind, rule in fired:
+                if kind == "exception":
+                    abort_why = "fault_injected"
+                elif kind == "slow" and rule is not None:
+                    time.sleep(rule.delay_s)
+                elif kind == "device_loss":
+                    source.crash()  # source dies after export
+        if abort_why is None and time.monotonic() > deadline:
+            abort_why = "timeout"
+        target = None
+        if abort_why is None:
+            target = self._choose_migration_target(flight, source)
+            if target is None:
+                abort_why = "no_target"
+        new_att = None
+        if abort_why is None:
+            try:
+                new_att = target.import_stream(blob, flight,
+                                               on_item=self.notify)
+            except Exception as e:  # noqa: BLE001
+                log.warning("migration import of req %d on %s failed: %s",
+                            flight.rid0, target.name, e)
+                abort_why = "import_failed"
+        if abort_why is not None:
+            try:
+                source.resolve_export(att, commit=False, why=abort_why)
+            except Exception:  # noqa: BLE001 — dead source resolves itself
+                pass
+            self.migrate_abort_count += 1
+            tm.FLEET_MIGRATIONS_TOTAL.labels(outcome="aborted").inc()
+            self.journal.record(
+                "migrate_abort", req_id=flight.rid0, user=flight.user,
+                model=flight.model or None, replica=source.name,
+                to_replica=target.name if target is not None else None,
+                why=abort_why)
+            log.warning("req %d migration off %s aborted (%s); falling "
+                        "back to recompute", flight.rid0, source.name,
+                        abort_why)
+            return "aborted"
+        # Import acked: release the parked source copy; the stream now
+        # lives on the target with zero recomputed tokens.
+        try:
+            source.resolve_export(att, commit=True)
+        except Exception:  # noqa: BLE001 — a dead source's parked state
+            pass  # dies with it; the import already owns the stream
+        # Flush the OLD attempt before swapping: the export froze the
+        # source, but its last pre-freeze tokens may still be in flight
+        # (an HTTP reader mid-socket). The commit just terminated the
+        # member-side stream, so drain until that terminal (the handoff
+        # ack, never client output) — only then does the target's
+        # continuation forward, keeping the client stream ordered.
+        flush_deadline = time.monotonic() + max(1.0,
+                                                self.migrate_timeout_s)
+        while time.monotonic() < flush_deadline:
+            item = att.req.stream.get_nowait()
+            if item is None:
+                if att.thread is None or att.reader_dead():
+                    break  # local attempt / dead reader: nothing more
+                time.sleep(0.002)
+                continue
+            if item.kind == "token":
+                self._forward_token(flight, item)
+            else:
+                break  # the commit's cancelled ack
+        att.closed = True
+        flight.member = target
+        flight.attempt = new_att
+        flight.resume = None
+        flight.failed_from = None
+        flight.evac_since = None
+        self.migration_count += 1
+        tm.FLEET_MIGRATIONS_TOTAL.labels(outcome="migrated").inc()
+        tm.FLEET_MIGRATE_BYTES_TOTAL.inc(nbytes)
+        self.journal.record(
+            "migrate_import", req_id=flight.rid0, user=flight.user,
+            model=flight.model or None, replica=source.name,
+            to_replica=target.name, tokens=n_gen,
+            pages=blob.get("n_pages"), bytes=nbytes)
+        self.journal.record("place", req_id=flight.rid0, user=flight.user,
+                            model=flight.model or None,
+                            runtime=target.name)
+        flight.req.trace_event("migrate", src=source.name,
+                               dst=target.name, why=why)
+        log.warning("req %d migrated %s -> %s (%s): %d token(s) shipped, "
+                    "0 recomputed", flight.rid0, source.name, target.name,
+                    why, n_gen)
+        return "migrated"
+
+    def _maybe_ship_prefix(self, flight: _Flight, target) -> None:
+        """Affinity miss with the cache elsewhere: ship the cached
+        prefix pages TO the chosen member instead of routing around it,
+        so the admission that follows prefills only the tail. Best
+        effort — any failure just means a cold prefill."""
+        if not self.migrate or self.placement != "affinity":
+            return
+        if flight.kind != "generate" or not flight.prompt_tokens:
+            return
+        if getattr(target, "import_prefix", None) is None:
+            return
+        try:
+            if target.affinity_pages(flight.model,
+                                     flight.prompt_tokens) > 0:
+                return  # the chosen member already holds a prefix
+            best, best_pages = None, 0
+            for mem in self.members:
+                if mem is target or mem.state == "ejected" \
+                        or not mem.alive() \
+                        or getattr(mem, "export_prefix", None) is None:
+                    continue
+                pages = mem.affinity_pages(flight.model,
+                                           flight.prompt_tokens)
+                if pages > best_pages:
+                    best, best_pages = mem, pages
+            if best is None:
+                return
+            blob = best.export_prefix(flight.model, flight.prompt_tokens)
+            if blob is None:
+                return
+            adopted = target.import_prefix(flight.model, blob)
+        except Exception:  # noqa: BLE001 — a cold prefill, not an error
+            log.exception("prefix shipping for req %d failed",
+                          flight.rid0)
+            return
+        if not adopted:
+            return
+        nbytes = kvc.migration_blob_bytes(blob)
+        tm.FLEET_MIGRATIONS_TOTAL.labels(outcome="prefix").inc()
+        tm.FLEET_MIGRATE_BYTES_TOTAL.inc(nbytes)
+        self.journal.record(
+            "migrate_import", req_id=flight.rid0, user=flight.user,
+            model=flight.model or None, what="prefix",
+            replica=best.name, to_replica=target.name,
+            pages=adopted, bytes=nbytes)
 
     # -------------------------------------------------------------- failover
     def _begin_evac(self, flight: _Flight) -> None:
@@ -786,6 +1031,13 @@ class FleetRouter:
         log.error("replica %s is now OFFLINE (%s); %d in-flight stream(s) "
                   "failing over", mem.name, why, len(victims))
         for flight in victims:
+            # Migration first: a crashed member's loop is dead but its
+            # KV pool and slot tables are frozen in place — exporting
+            # them beats re-deriving every emitted token. Fallback is
+            # the recompute evacuation (mandatory after an aborted
+            # handoff: the parked source state is gone).
+            if self._try_migrate(flight, mem, why="eject") == "migrated":
+                continue
             self._begin_evac(flight)
         self._update_gauges()
 
@@ -884,6 +1136,24 @@ class FleetRouter:
                 continue
             active = [f for f in self.flights
                       if f.member is mem and not f.done]
+            # Migrate the live streams OFF the draining member instead
+            # of running them out: the drain finishes as fast as the
+            # transfers, and stragglers stop being a timeout problem.
+            # "intact" outcomes (mid-prefill work, no target capacity)
+            # keep serving on the draining member and retry next sweep;
+            # an ABORTED handoff released the source state, so that
+            # stream must evacuate (recompute replay) right now.
+            for flight in active:
+                if flight.evac_since is None and not flight.migrate_tried:
+                    out = self._try_migrate(flight, mem, why="drain")
+                    if out == "aborted":
+                        self._begin_evac(flight)
+                    # Only a hard outcome consumes the attempt; capacity
+                    # may free up before the drain deadline.
+                    if out != "intact":
+                        flight.migrate_tried = True
+            active = [f for f in self.flights
+                      if f.member is mem and not f.done]
             if not active:
                 try:
                     mem.hot_restart()
@@ -927,9 +1197,13 @@ class FleetRouter:
         return {
             "placement": self.placement,
             "drain_timeout_s": self.drain_timeout_s,
+            "migrate": self.migrate,
+            "migrate_timeout_s": self.migrate_timeout_s,
             "replicas": rows,
             "counts": self.fleet_counts(),
             "failovers": self.failover_count,
+            "migrations": self.migration_count,
+            "migrate_aborts": self.migrate_abort_count,
             "queued": self.core.total_queued(),
         }
 

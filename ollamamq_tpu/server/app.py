@@ -142,6 +142,18 @@ class Server:
         if hasattr(self.engine, "drain_replica"):
             r.add_route("GET", "/admin/fleet", self.admin_fleet)
             r.add_route("POST", "/admin/drain/{replica}", self.admin_drain)
+        # KV migration wire (only when the engine IS an engine, not a
+        # router): the fleet's HttpMember speaks these to ship a live
+        # stream's pages + request state between member services.
+        if hasattr(self.engine, "export_stream"):
+            r.add_route("POST", "/admin/migrate/export",
+                        self.admin_migrate_export)
+            r.add_route("POST", "/admin/migrate/import",
+                        self.admin_migrate_import)
+            r.add_route("POST", "/admin/migrate/commit",
+                        self.admin_migrate_commit)
+            r.add_route("POST", "/admin/migrate/abort",
+                        self.admin_migrate_abort)
         if self.allow_all_routes:
             r.add_route("*", "/{tail:.*}", self.fallback)
         return app
@@ -181,11 +193,14 @@ class Server:
         return entry  # may be None: known architecture, not registered
 
     def _enqueue(self, user, ip, model, family, prompt_tokens, sampling,
-                 kind="generate", raw_prompt="") -> Request:
+                 kind="generate", raw_prompt="",
+                 context_ids=None) -> Request:
         try:
+            kw = {"kind": kind, "raw_prompt": raw_prompt}
+            if context_ids:
+                kw["context_ids"] = context_ids
             return self.engine.enqueue_request(
-                user, ip, model, family, prompt_tokens, sampling,
-                kind=kind, raw_prompt=raw_prompt,
+                user, ip, model, family, prompt_tokens, sampling, **kw,
             )
         except BlockedError as e:
             raise ApiError(403, str(e))
@@ -601,6 +616,92 @@ class Server:
             raise ApiError(409, str(e))
         return web.json_response({"status": "success", **out})
 
+    # ------------------------------------------------- KV migration wire
+    def _migrate_rid(self, body: dict) -> int:
+        try:
+            return int(body["req_id"])
+        except (KeyError, TypeError, ValueError):
+            raise ApiError(400, "'req_id' must be an integer")
+
+    async def admin_migrate_export(self, request: web.Request) -> web.Response:
+        """Phase 1 of the two-phase handoff, source side: snapshot +
+        PARK one live stream's decode slot (pages, decode cursor,
+        penalty ring, request state) and ship it as a binary blob. The
+        source keeps the parked state until /admin/migrate/commit (the
+        target acked) or /admin/migrate/abort (fall back to recompute)
+        resolves it. 409 when the request holds no migratable state."""
+        self._ident(request)
+        body = await self._body_json(request)
+        rid = self._migrate_rid(body)
+        try:
+            budget = min(60.0, max(0.1, float(body.get("timeout_s", 10.0))))
+        except (TypeError, ValueError):
+            raise ApiError(400, "'timeout_s' must be a number")
+        deadline = time.monotonic() + budget
+        blob = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.engine.export_stream(rid, deadline))
+        if blob is None:
+            raise ApiError(
+                409, f"request {rid} holds no migratable decode state")
+        from ollamamq_tpu.engine.kv_cache import pack_migration_blob
+
+        return web.Response(body=pack_migration_blob(blob),
+                            content_type="application/octet-stream")
+
+    async def admin_migrate_import(self, request: web.Request):
+        """Target side: install a shipped stream straight into a decode
+        slot and STREAM its continuation as /api/generate NDJSON. The
+        2xx status line is the import ack the source's commit waits on —
+        it is only sent after the slot is installed; a 409 means nothing
+        landed and the caller must fall back to recompute."""
+        user, ip = self._ident(request)
+        from ollamamq_tpu.engine.engine import MigrationError
+        from ollamamq_tpu.engine.kv_cache import unpack_migration_blob
+
+        raw = await request.read()
+        try:
+            blob = unpack_migration_blob(raw)
+        except ValueError as e:
+            raise ApiError(400, f"bad migration blob: {e}")
+        deadline = None
+        hdr = request.headers.get("X-Deadline-Ms")
+        if hdr is not None:
+            try:
+                deadline = time.monotonic() + max(1.0, float(hdr)) / 1e3
+            except ValueError:
+                raise ApiError(400, "X-Deadline-Ms must be a number")
+        try:
+            req = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: self.engine.import_stream(
+                    blob, ip=ip, deadline=deadline))
+        except MigrationError as e:
+            raise ApiError(409, f"migration import failed: {e}")
+        model = req.model or (blob.get("request") or {}).get("model", "")
+        return await self._ollama_stream(request, model, req, chat=False)
+
+    async def admin_migrate_commit(self, request: web.Request) -> web.Response:
+        return await self._migrate_resolve(request, commit=True)
+
+    async def admin_migrate_abort(self, request: web.Request) -> web.Response:
+        return await self._migrate_resolve(request, commit=False)
+
+    async def _migrate_resolve(self, request: web.Request,
+                               commit: bool) -> web.Response:
+        """Phase 2: release the parked source state (commit and abort
+        free identically; abort journals why and signals the recompute
+        fallback). 404 when no export is parked under that id."""
+        self._ident(request)
+        body = await self._body_json(request)
+        rid = self._migrate_rid(body)
+        why = str(body.get("why") or "transfer_failed")
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.engine.resolve_export(
+                rid, commit=commit, why=why))
+        if not ok:
+            raise ApiError(404, f"no parked migration export for "
+                                f"request {rid}")
+        return web.json_response({"status": "success", "req_id": rid})
+
     async def debug_profile(self, request: web.Request) -> web.Response:
         """Capture a jax.profiler trace of the live engine for N seconds
         (the tracing/profiling subsystem the reference lacks entirely).
@@ -664,8 +765,19 @@ class Server:
         # no vision path exists, so the response SAYS so (a `warnings`
         # field) instead of silently answering from text alone.
         tokens = self._tokenize(model, prompt)
+        # Ollama's `context` field: token ids from a prior turn (or the
+        # fleet router's token-space failover replay). The engine
+        # re-prefills prompt + exact ids and continues the stream from
+        # there — num_predict still budgets NEW tokens only.
+        context = body.get("context") or []
+        if context and not (isinstance(context, list)
+                            and all(isinstance(t, int)
+                                    and not isinstance(t, bool)
+                                    for t in context)):
+            raise ApiError(400, "'context' must be a list of token ids")
         req = self._enqueue(user, ip, model, Family.OLLAMA, tokens, sampling,
-                            raw_prompt=prompt)
+                            raw_prompt=prompt,
+                            context_ids=context or None)
         if body.get("images"):
             req.images_ignored = True
 
@@ -728,8 +840,19 @@ class Server:
         resp.content_type = "application/x-ndjson"
         await resp.prepare(request)
 
+        # Every frame carries the engine-side request id and the sampled
+        # token ids its text covers (held-back tokens' ids ride the next
+        # written frame, so the id stream is complete): the fleet router
+        # reads these to resume a failed-over stream in TOKEN space —
+        # verified token-identical — and to key /admin/migrate exports.
+        pending_ids: list = []
+
         def chunk(text):
-            p = {"model": model, "created_at": _now_iso(), "done": False}
+            p = {"model": model, "created_at": _now_iso(), "done": False,
+                 "req_id": req.req_id}
+            if pending_ids:
+                p["token_ids"] = pending_ids[:]
+                pending_ids.clear()
             if chat:
                 p["message"] = {"role": "assistant", "content": text}
             else:
@@ -738,19 +861,26 @@ class Server:
 
         try:
             async for item in self._aiter(req):
-                if item.kind == "token" and item.text:
-                    await resp.write(chunk(item.text))
+                if item.kind == "token":
+                    if item.token_id >= 0:
+                        pending_ids.append(item.token_id)
+                    if item.text:
+                        await resp.write(chunk(item.text))
                 elif item.kind == "error":
                     await resp.write((json.dumps(
                         {"model": model, "created_at": _now_iso(),
-                         "done": True,
+                         "done": True, "req_id": req.req_id,
                          "done_reason": self._error_reason(item),
                          "error": item.error}) + "\n").encode())
                     break
                 elif item.kind == "done":
                     p = {"model": model, "created_at": _now_iso(), "done": True,
                          "done_reason": self._done_reason(item),
+                         "req_id": req.req_id,
                          **self._gen_stats(req)}
+                    if pending_ids:
+                        p["token_ids"] = pending_ids[:]
+                        pending_ids.clear()
                     if getattr(req, "images_ignored", False):
                         p["warnings"] = [_IMAGES_IGNORED]
                     if chat:

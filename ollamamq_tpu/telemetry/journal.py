@@ -72,6 +72,11 @@ EVENTS = (
     "replica_drain",     # replica quiesced: no new placements, in-flight
     #                      streams run to completion
     "replica_join",      # replica (re)entered rotation, by reason
+    # KV page migration (two-phase handoff; fleet/router.py + engine):
+    "migrate_export",    # source snapshot taken, slot detached/parked
+    "migrate_import",    # target installed the shipped state (the ack)
+    "migrate_abort",     # transfer failed; source state released and the
+    #                      stream falls back to recompute replay
 )
 
 # kind -> (required fields, optional fields) beyond the common header
@@ -137,6 +142,16 @@ EVENT_FIELDS: Dict[str, Tuple[tuple, tuple]] = {
     "replica_failover": (("replica",), ("to_replica", "replayed_tokens")),
     "replica_drain": (("replica",), ("inflight", "timeout_s")),
     "replica_join": (("replica",), ("why",)),
+    # Migration records carry the shipped state's size (tokens already
+    # generated = what recompute would have re-derived; pages/bytes =
+    # what actually moved) and, router-side, the members involved.
+    # `what` tells a stream handoff from a shipped prefix.
+    "migrate_export": (("tokens",),
+                       ("replica", "kv_len", "pages", "bytes")),
+    "migrate_import": ((),
+                       ("replica", "to_replica", "tokens", "pages",
+                        "bytes", "what")),
+    "migrate_abort": (("why",), ("replica", "to_replica")),
 }
 assert set(EVENT_FIELDS) == set(EVENTS)
 
@@ -151,7 +166,8 @@ _FIELD_SETS = {k: (frozenset(req), frozenset(req) | frozenset(opt))
 DECISION_KINDS = ("enqueue", "admit", "sched", "place", "shed", "batch",
                   "install", "preempt", "requeue", "retry", "poison",
                   "deadline_drop", "finish", "replica_eject",
-                  "replica_failover", "replica_drain", "replica_join")
+                  "replica_failover", "replica_drain", "replica_join",
+                  "migrate_export", "migrate_import", "migrate_abort")
 
 # Per-kind fields folded into the replay signature (deterministic given
 # the same arrivals; excludes timestamps, latencies, and page ids).
@@ -463,6 +479,35 @@ def explain(rec: dict) -> str:
     if kind == "replica_join":
         return (f"replica {rec.get('replica', '?')} joined rotation "
                 f"({rec.get('why', 'start')})")
+    if kind == "migrate_export":
+        s = (f"{who} KV state exported for migration "
+             f"({rec.get('tokens', '?')} generated token(s)")
+        if rec.get("pages") is not None:
+            s += f", {rec['pages']} page(s)"
+        if rec.get("replica"):
+            s += f", from replica {rec['replica']}"
+        return s + ")"
+    if kind == "migrate_import":
+        if rec.get("what") == "prefix":
+            return (f"cached prefix shipped "
+                    f"{rec.get('replica', '?')} -> "
+                    f"{rec.get('to_replica', '?')} "
+                    f"({rec.get('pages', '?')} page(s), "
+                    f"{rec.get('bytes', '?')} bytes)")
+        s = f"{who} migrated"
+        if rec.get("replica") or rec.get("to_replica"):
+            s += (f" {rec.get('replica', '?')} -> "
+                  f"{rec.get('to_replica', '?')}")
+        s += f": resumed from shipped state at {rec.get('tokens', '?')} "
+        s += "token(s), 0 recomputed"
+        if rec.get("bytes") is not None:
+            s += f" ({rec['bytes']} bytes moved)"
+        return s
+    if kind == "migrate_abort":
+        s = f"{who} migration aborted ({rec.get('why', '?')})"
+        if rec.get("replica"):
+            s += f" on replica {rec['replica']}"
+        return s + "; falling back to recompute replay"
     return f"{kind} {who}"
 
 

@@ -236,6 +236,17 @@ FLEET_AFFINITY_HITS_TOTAL = REGISTRY.counter(
     "Placements routed to the replica whose prefix-cache radix tree "
     "already held the prompt's prefix (--placement=affinity); misses "
     "fall back to least-loaded")
+FLEET_MIGRATIONS_TOTAL = REGISTRY.counter(
+    "ollamamq_fleet_migrations_total",
+    "KV page migrations between fleet members by outcome: 'migrated' "
+    "(stream resumed from shipped state on the target), 'aborted' "
+    "(transfer failed; the stream fell back to recompute replay), "
+    "'prefix' (an affinity-miss shipped cached prefix pages to the "
+    "chosen member)", labels=("outcome",))
+FLEET_MIGRATE_BYTES_TOTAL = REGISTRY.counter(
+    "ollamamq_fleet_migrate_bytes_total",
+    "KV page payload bytes shipped between fleet members (migrations "
+    "and prefix shipping; int8 pools move ~2x fewer bytes than bf16)")
 
 # -- host / device ---------------------------------------------------------
 HBM_USED_BYTES = REGISTRY.gauge(

@@ -34,7 +34,15 @@ Each rule names ONE site and ONE trigger:
            probes members in order each health sweep, so the per-site
            call counter indexes (sweep, member) — "exception" crashes
            the probed member, "slow" forces its heartbeat stale for
-           delay_s, "device_loss" keeps it down until heal_after_s).
+           delay_s, "device_loss" keeps it down until heal_after_s), or
+           the router's KV-migration seam ("migrate", drawn once per
+           attempted stream migration AFTER the source export:
+           "exception" fails the transfer mid-flight (fallback to
+           recompute), "slow" stalls the transfer delay_s — past the
+           router's --migrate-timeout-s budget it aborts — and
+           "device_loss" kills the SOURCE member right after export,
+           exercising the orphaned-export half of the two-phase
+           handoff).
   kind     "exception"  -> the dispatch raises FaultInjected (the
                            engine's retry/containment path handles it);
            "slow"       -> the dispatch sleeps delay_s first (stall
@@ -70,7 +78,8 @@ import time
 from typing import Dict, List, Optional
 
 SITES = ("prefill", "chunk", "sp_prefill", "ragged", "spec_verify",
-         "decode", "embed", "encode", "step", "alloc", "extend", "replica")
+         "decode", "embed", "encode", "step", "alloc", "extend", "replica",
+         "migrate")
 KINDS = ("exception", "slow", "alloc_fail", "device_loss")
 
 _RULE_KEYS = {"site", "kind", "at", "every", "p", "times", "delay_s",

@@ -39,7 +39,11 @@ Record/replay (the determinism acceptance loop):
         same simulate twice is deterministic (identical signature), and
         `simulate --scheduler fcfs` of an fcfs recording IS a replay —
         so the promotion story is: record a trace, simulate every
-        policy, ship the winner behind --scheduler.
+        policy, ship the winner behind --scheduler. Accepts LIVE
+        --journal-file spills too (not just `record` traces): arrivals
+        are tick-normalized relative to the first one (idle gaps capped)
+        and the engine shape is read off the spill's journal_meta, so
+        the counterfactual runs over production traffic.
 
 Stdlib + engine imports only on demand: tail/explain/stats/check need no
 jax and no engine.
@@ -82,31 +86,55 @@ _BIMODAL_FAULTS = {"seed": 0, "faults": []}
 
 def check_no_dropped_streams(records: List[dict]) -> List[str]:
     """Fleet zero-drop invariant (end-of-run semantics): every stream a
-    replica failure touched must reach a terminal record. The fleet
-    router journals under each stream's ORIGINAL router request id —
-    stable across failovers and requeues — so the audit is a straight
-    pairing: a `replica_failover` (or a `replica_eject` with victims)
-    whose req never reaches finish / shed / deadline_drop / poison by
-    the end of the journal is a dropped stream.
+    replica failure OR a KV migration touched must reach a terminal
+    record. The fleet router journals under each stream's ORIGINAL
+    router request id — stable across failovers, requeues, and
+    migrations — so the audit is a straight pairing:
+
+      - a `replica_failover` / `migrate_export` / `migrate_import` whose
+        req never reaches finish / shed / deadline_drop / poison by the
+        end of the journal is a dropped stream;
+      - a `migrate_export` resolved by NEITHER `migrate_import` nor
+        `migrate_abort` nor a terminal for its req is an orphaned
+        two-phase handoff (source state parked forever).
 
     Run this on COMPLETE journals (a finished bench/chaos run, a drained
     spill) — a live ring mid-failover would report in-flight streams as
     violations, which is why this lives here and not in the health
     monitor's live invariant sweep."""
-    pending: dict = {}  # rid -> seq of the last failover that touched it
+    pending: dict = {}  # rid -> seq of the last failover/migration touch
+    open_handoff: dict = {}  # rid -> seq of an unresolved migrate_export
     terminal = ("finish", "shed", "deadline_drop", "poison")
     for r in records:
         kind = r.get("kind")
         rid = r.get("req_id")
-        if kind == "replica_failover" and rid is not None:
+        if rid is None:
+            continue
+        if kind == "replica_failover":
             pending[rid] = r.get("seq", "?")
-        elif kind in terminal and rid is not None:
+        elif kind == "migrate_export":
+            pending[rid] = r.get("seq", "?")
+            open_handoff[rid] = r.get("seq", "?")
+        elif kind == "migrate_import":
+            pending[rid] = r.get("seq", "?")
+            open_handoff.pop(rid, None)
+        elif kind == "migrate_abort":
+            open_handoff.pop(rid, None)
+        elif kind in terminal:
             pending.pop(rid, None)
-    return [
-        f"req {rid} stream DROPPED: replica_failover at seq {seq} with no "
-        "terminal record (finish/shed/deadline_drop/poison) by journal end"
+            open_handoff.pop(rid, None)
+    bad = [
+        f"req {rid} stream DROPPED: replica_failover/migration at seq {seq}"
+        " with no terminal record (finish/shed/deadline_drop/poison) by "
+        "journal end"
         for rid, seq in sorted(pending.items())
     ]
+    bad += [
+        f"req {rid} migration ORPHANED: migrate_export at seq {seq} never "
+        "resolved by migrate_import/migrate_abort or a terminal record"
+        for rid, seq in sorted(open_handoff.items())
+    ]
+    return bad
 
 
 def _gen_arrivals(seed: int, n: int) -> List[dict]:
@@ -164,6 +192,34 @@ def _arrivals_from_records(records: List[dict]) -> List[dict]:
             out.append({"tick": r.get("tick", 0), "user": r.get("user", "?"),
                         "n_prompt": int(r.get("n_prompt") or 4),
                         "max_tokens": int(r.get("max_tokens") or 8)})
+    return out
+
+
+# A live engine's tick is its loop-iteration counter: it starts wherever
+# the process happens to be and idles forward between arrivals, so a raw
+# spill's tick axis is offset and full of dead gaps. Normalization caps
+# each inter-arrival gap here — wide enough that the engine drains
+# between genuinely separated bursts, bounded so a quiet hour in a spill
+# doesn't cost a million empty virtual ticks.
+MAX_ARRIVAL_GAP_TICKS = 16
+
+
+def normalize_arrival_ticks(arrivals: List[dict]) -> List[dict]:
+    """Arrival-RELATIVE tick normalization for live spilled journals:
+    rebase the first arrival to tick 0 and clamp every inter-arrival gap
+    to MAX_ARRIVAL_GAP_TICKS, preserving order and coincidence (arrivals
+    sharing a recorded tick still share a virtual one). Synthetic
+    `record` traces are already compact and are replayed verbatim — this
+    only runs when a journal carries no scenario meta."""
+    out = []
+    vtick = 0
+    prev = None
+    for a in arrivals:
+        t = int(a.get("tick", 0))
+        if prev is not None:
+            vtick += min(max(0, t - prev), MAX_ARRIVAL_GAP_TICKS)
+        prev = t
+        out.append({**a, "tick": vtick})
     return out
 
 
@@ -257,19 +313,35 @@ def simulate_journal(path: str, scheduler: str):
     workflow). Returns (recorded_records, simulated_records). Same
     machinery as replay — synchronous virtual-tick driving — so the
     simulated decision stream is a pure function of (recording, policy):
-    the same simulate twice yields an identical decision_signature."""
+    the same simulate twice yields an identical decision_signature.
+
+    Works on BOTH journal shapes: a `record`-ed trace replays its
+    scenario verbatim (engine shape + fault plan from the meta), and a
+    LIVE engine's spill is re-driven over its normalized arrival
+    sequence (arrival-relative ticks, the engine shape read off the
+    spill's own journal_meta, no faults) — so the promotion workflow
+    runs over production traffic, not just synthetic traces."""
     meta, records = load_jsonl(path)
     scenario = meta.get("scenario")
-    if not scenario:
-        raise SystemExit(
-            f"{path} carries no scenario meta: simulate needs a journal "
-            "written by `tools/journal record` (a live engine's spill "
-            "lacks the engine shape + fault plan to re-drive)")
-    arrivals = _arrivals_from_records(records)
-    engine = dict(scenario["engine"])
+    if scenario:
+        arrivals = _arrivals_from_records(records)
+        engine = dict(scenario["engine"])
+        faults = scenario["fault_plan"]
+    else:
+        # Live spill: no scenario meta. Arrival-relative ticks + the
+        # journal header's engine shape make it re-drivable; injected
+        # faults are not (wall-clock device failures don't replay).
+        arrivals = normalize_arrival_ticks(_arrivals_from_records(records))
+        if not arrivals:
+            raise SystemExit(
+                f"{path} holds no enqueue records: nothing to simulate")
+        engine = {"max_slots": int(meta.get("max_slots") or 4),
+                  "max_queued": 0, "max_queued_per_user": 0,
+                  "step_retries": 1}
+        faults = {}
     engine["scheduler"] = scheduler
     fresh = Journal(capacity=max(4096, len(records) * 4 + 64))
-    drive_chaos(arrivals, scenario["fault_plan"], engine, fresh)
+    drive_chaos(arrivals, faults, engine, fresh)
     return records, fresh.tail(None)
 
 

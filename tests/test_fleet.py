@@ -202,7 +202,10 @@ def test_failover_byte_identity_fuzz(prefix_cache, spec):
         assert texts == gtexts
         recs = router.journal.tail(None)
         assert any(r["kind"] == "replica_eject" for r in recs)
-        assert router.failover_count >= 1
+        # Recovery is migration-first (zero recomputed tokens), with
+        # recompute failover as the fallback — either way the victim
+        # streams above continued byte-identically.
+        assert router.migration_count + router.failover_count >= 1
         assert check_no_dropped_streams(recs) == []
         from ollamamq_tpu.telemetry.journal import check_invariants
 
@@ -454,10 +457,14 @@ def test_http_members_serve_and_fail_over():
         backends[int(mem.name[1])].stop()  # the service dies mid-stream
         items = collect(req, timeout=60)
         assert items[-1].kind == "done"
-        # The fake backend's word stream is index-based, so the replayed
-        # text renumbers — the zero-drop contract here is the TOKEN
-        # count: exactly max_tokens items, one terminal, no gap.
-        assert len([i for i in items if i.kind == "token"]) == 16
+        # The NDJSON frames carry token_ids, so the resumed stream
+        # replays in TOKEN space (Ollama `context`): the surviving
+        # backend continues the word cursor where the dead one stopped —
+        # byte-identical, verified token-identical, no gap.
+        tokens = [i for i in items if i.kind == "token"]
+        assert len(tokens) == 16
+        assert _text(items) == "".join(f"word{i} " for i in range(16))
+        assert [i.token_id for i in tokens] == list(range(1, 17))
         assert router.failover_count >= 1
         assert check_no_dropped_streams(router.journal.tail(None)) == []
     finally:
@@ -478,6 +485,12 @@ def test_fleet_journal_kinds_schema_and_explanations():
              to_replica="r0", replayed_tokens=5)
     j.record("replica_drain", replica="r0", inflight=2, timeout_s=30.0)
     j.record("replica_join", replica="r1", why="heal")
+    j.record("migrate_export", req_id=7, user="u", replica="r1",
+             tokens=5, kv_len=21, pages=3, bytes=4096)
+    j.record("migrate_import", req_id=7, user="u", replica="r1",
+             to_replica="r0", tokens=5, pages=3, bytes=4096)
+    j.record("migrate_abort", req_id=8, user="u", replica="r1",
+             why="timeout")
     texts = [explain(r) for r in j.tail(None)]
     assert "r1 ejected (stale_heartbeat)" in texts[0]
     assert "3 in-flight stream(s)" in texts[0]
@@ -485,10 +498,18 @@ def test_fleet_journal_kinds_schema_and_explanations():
     assert "replaying 5" in texts[1]
     assert "draining" in texts[2]
     assert "joined rotation (heal)" in texts[3]
+    assert "exported for migration" in texts[4]
+    assert "0 recomputed" in texts[5] and "r1 -> r0" in texts[5]
+    assert "aborted (timeout)" in texts[6]
+    assert "recompute" in texts[6]
     with pytest.raises(JournalError):
         j.record("replica_eject", why="missing-replica-field")
     with pytest.raises(JournalError):
         j.record("replica_failover", replica="r1", bogus=1)
+    with pytest.raises(JournalError):
+        j.record("migrate_export", replica="r1")  # missing tokens
+    with pytest.raises(JournalError):
+        j.record("migrate_abort", replica="r1")  # missing why
 
 
 def test_no_dropped_streams_checker_flags_missing_terminal():
@@ -547,7 +568,373 @@ def test_cli_fleet_flag_validation():
     assert main(["--replicas", "0", "--no-tui"]) == 2
     assert main(["--replicas", "-1", "--no-tui"]) == 2
     assert main(["--drain-timeout-s", "0", "--no-tui"]) == 2
+    assert main(["--migrate-timeout-s", "0", "--no-tui"]) == 2
+    assert main(["--migrate-timeout-s", "-1", "--no-tui"]) == 2
     assert main(["--replicas", "2", "--spmd", "--no-tui"]) == 2
+
+
+# ------------------------------------------------------------- migration
+def _alloc_conserved(router):
+    """free + used + cached == pool on every member runtime."""
+    for mem in router.local_members:
+        for rt in mem.engine.runtimes.values():
+            alloc = getattr(rt, "alloc", None)
+            if alloc is None:
+                continue
+            assert (alloc.free_pages + alloc.used_pages
+                    + alloc.cached_pages == alloc.num_pages - 1), (
+                f"{mem.name}: free {alloc.free_pages} + used "
+                f"{alloc.used_pages} + cached {alloc.cached_pages} "
+                f"!= pool {alloc.num_pages - 1}")
+
+
+def _member_journals_clean(router):
+    from ollamamq_tpu.telemetry.journal import check_invariants
+
+    for mem in router.local_members:
+        assert check_invariants(mem.engine.journal.tail(None)) == [], \
+            mem.name
+
+
+@pytest.mark.parametrize(
+    "prefix_cache,kv_dtype,spec,seed",
+    [(False, "bfloat16", False, 0), (True, "bfloat16", False, 1),
+     (False, "int8", False, 2), (True, "int8", True, 3)],
+    ids=["plain", "cache", "int8", "cache+int8+spec"])
+def test_migration_fuzz_byte_identity_and_page_conservation(
+        prefix_cache, kv_dtype, spec, seed):
+    """Kill a member at a randomized decode depth across the
+    prefix-cache x int8-KV x spec matrix: victim streams MIGRATE (KV
+    pages shipped, zero recomputed tokens), every stream matches the
+    single-replica golden byte for byte, and page conservation
+    (free+used+cached==pool) holds on BOTH members through the
+    export/import/abort traffic."""
+    import random
+
+    over = dict(prefix_cache=prefix_cache, kv_dtype=kv_dtype, spec=spec,
+                spec_k=2)
+    prompts = [
+        "the cat sat on the mat the cat sat on the",
+        "the cat sat on the mat the cat sat on a",
+        "pack my box with five dozen jugs",
+        "the cat sat on the mat the cat sat on my",
+        "pack my box with five dozen mugs",
+        "the cat sat on the mat the cat",
+    ]
+    # Randomized decode depth for the kill, kept shallow enough that
+    # the victim member still holds live streams when the eject's
+    # migration pass runs (the dying loop finishes its current
+    # iteration first).
+    depth = random.Random(seed).randrange(1, 6)
+    golden = _tpu_fleet(n=1, **over)
+    try:
+        gtexts = [_text(collect(_run(golden, f"mg{i % 2}", p,
+                                     max_tokens=16)))
+                  for i, p in enumerate(prompts)]
+    finally:
+        golden.stop()
+
+    router = _tpu_fleet(n=2, **over)
+    try:
+        reqs = [_run(router, f"mg{i % 2}", p, max_tokens=16)
+                for i, p in enumerate(prompts)]
+        deadline = time.monotonic() + 120
+        victim = None
+        while time.monotonic() < deadline and victim is None:
+            for f in list(router.flights):
+                if f.attempt is not None \
+                        and len(f.attempt.req.generated_ids) >= depth:
+                    victim = f.member
+                    break
+            time.sleep(0.01)
+        assert victim is not None, "no stream reached the kill depth"
+        victim.crash()
+        texts = [_text(collect(r)) for r in reqs]
+        assert texts == gtexts
+        recs = router.journal.tail(None)
+        migrated = [r for r in recs if r["kind"] == "migrate_import"
+                    and r.get("what") != "prefix"]
+        assert migrated, "the crash should have migrated at least one " \
+                         "stream (state was frozen, not lost)"
+        assert router.migration_count >= 1
+        assert tm.FLEET_MIGRATIONS_TOTAL.labels(
+            outcome="migrated").value >= 1
+        # Two-phase completeness + zero drops on the router journal,
+        # page conservation + invariants on each member's own journal.
+        assert check_no_dropped_streams(recs) == []
+        from ollamamq_tpu.telemetry.journal import check_invariants
+
+        assert check_invariants(recs) == []
+        _member_journals_clean(router)
+        # Let the healed member's restart settle before the allocator
+        # sweep (pages of evacuated slots reclaim via cancellation).
+        deadline = time.monotonic() + 30
+        while router.fleet_counts()["healthy"] < 2 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        _alloc_conserved(router)
+    finally:
+        router.stop()
+
+
+def test_drain_migrates_streams_instead_of_running_them_out():
+    """/admin/drain ships live streams to healthy members: the drain
+    completes without waiting out long generations, the migrated word
+    streams continue their numbering seamlessly, and nothing drops."""
+    router = _fake_fleet(n=2, token_latency_s=0.05)
+    try:
+        reqs = [_run(router, f"dm{i}", max_tokens=16) for i in range(4)]
+        # Wait until every stream is placed and mid-generation.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            placed = [f for f in list(router.flights)
+                      if f.attempt is not None]
+            if len(placed) == 4 and all(
+                    f.attempt.req.generated_ids for f in placed):
+                break
+            time.sleep(0.01)
+        router.drain_replica("r0")
+        for r in reqs:
+            items = collect(r)
+            assert items[-1].kind == "done"
+            text = _text(items)
+            assert text.startswith("word0 word1 ")
+            # Seamless continuation: the word cursor migrated with the
+            # stream, so numbering never restarts.
+            words = text.split()
+            assert words == [f"word{i}" for i in range(len(words))]
+        recs = router.journal.tail(None)
+        assert any(r["kind"] == "migrate_export" for r in recs)
+        assert any(r["kind"] == "migrate_import" for r in recs)
+        assert router.migration_count >= 1
+        assert check_no_dropped_streams(recs) == []
+        deadline = time.monotonic() + 30
+        while router.fleet_counts()["healthy"] < 2 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert router.fleet_counts()["healthy"] == 2
+    finally:
+        router.stop()
+
+
+def test_migration_mid_transfer_crash_falls_back_to_recompute():
+    """faults.py site "migrate": the first transfer dies mid-flight
+    (exception) and the second loses its SOURCE right after export
+    (device_loss) — both abort into the recompute-replay fallback with
+    zero dropped streams and a clean two-phase journal pairing."""
+    plan = FaultPlan([
+        {"site": "migrate", "kind": "exception", "at": [1]},
+    ])
+    router = _fake_fleet(n=2, token_latency_s=0.05, plan=plan)
+    try:
+        reqs = [_run(router, f"ab{i}", max_tokens=16) for i in range(3)]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            placed = [f for f in list(router.flights)
+                      if f.member is not None
+                      and f.member.name == "r0"
+                      and f.attempt is not None
+                      and f.attempt.req.generated_ids]
+            if placed:
+                break
+            time.sleep(0.01)
+        assert placed, "no stream mid-generation on r0"
+        router.drain_replica("r0")
+        for r in reqs:
+            items = collect(r)
+            assert items[-1].kind == "done"
+            words = _text(items).split()
+            assert words == [f"word{i}" for i in range(len(words))]
+        recs = router.journal.tail(None)
+        aborts = [r for r in recs if r["kind"] == "migrate_abort"]
+        assert aborts and aborts[0]["why"] == "fault_injected"
+        assert tm.FLEET_MIGRATIONS_TOTAL.labels(
+            outcome="aborted").value >= 1
+        # The aborted handoff is paired (export -> abort) and the stream
+        # still reached its terminal: nothing dropped, nothing orphaned.
+        assert check_no_dropped_streams(recs) == []
+    finally:
+        router.stop()
+
+
+def test_migration_source_death_after_export_still_lands():
+    """site "migrate" device_loss: the source member dies right after
+    the export snapshot. The import still lands (the blob is already
+    off the member), the commit resolves inline against the dead loop,
+    and the ejected source heals back in later."""
+    plan = FaultPlan([
+        {"site": "migrate", "kind": "device_loss", "at": [1],
+         "heal_after_s": 0.5},
+    ])
+    router = _fake_fleet(n=2, token_latency_s=0.05, plan=plan)
+    try:
+        reqs = [_run(router, f"dl{i}", max_tokens=16) for i in range(3)]
+        deadline = time.monotonic() + 30
+        placed = []
+        while time.monotonic() < deadline:
+            placed = [f for f in list(router.flights)
+                      if f.member is not None
+                      and f.member.name == "r0"
+                      and f.attempt is not None
+                      and f.attempt.req.generated_ids]
+            if placed:
+                break
+            time.sleep(0.01)
+        assert placed, "no stream mid-generation on r0"
+        router.drain_replica("r0")
+        for r in reqs:
+            items = collect(r)
+            assert items[-1].kind == "done"
+            words = _text(items).split()
+            assert words == [f"word{i}" for i in range(len(words))]
+        recs = router.journal.tail(None)
+        assert any(r["kind"] == "migrate_import" for r in recs)
+        assert check_no_dropped_streams(recs) == []
+    finally:
+        router.stop()
+
+
+def test_affinity_miss_ships_prefix_to_chosen_member():
+    """When the cached member can't take the request, the prefix ships
+    TO the chosen member instead of the router routing around it: the
+    target's radix tree gains the pages and journals the shipment."""
+    router = _tpu_fleet(n=2, prefix_cache=True)
+    try:
+        prompt = "shared system preamble for prefix shipping tests ok"
+        collect(_run(router, "ps", prompt, max_tokens=4))
+        holder = router.journal.tail(None, kind="place")[-1]["runtime"]
+        src = next(m for m in router.members if m.name == holder)
+        dst = next(m for m in router.members if m.name != holder)
+        tokens = router.resolve_runtime("test-tiny").tokenizer.encode(
+            prompt)
+        assert src.affinity_pages("test-tiny", tokens) >= 1
+        assert dst.affinity_pages("test-tiny", tokens) == 0
+        flight = type("F", (), {"rid0": 999, "user": "ps", "model":
+                      "test-tiny", "kind": "generate",
+                      "prompt_tokens": tokens})()
+        router._maybe_ship_prefix(flight, dst)
+        assert dst.affinity_pages("test-tiny", tokens) >= 1
+        ships = [r for r in router.journal.tail(None, kind="migrate_import")
+                 if r.get("what") == "prefix"]
+        assert ships and ships[-1]["replica"] == holder \
+            and ships[-1]["to_replica"] == dst.name
+        _alloc_conserved(router)
+        _member_journals_clean(router)
+    finally:
+        router.stop()
+
+
+def test_http_member_drain_migrates_over_admin_migrate_wire():
+    """HTTP-member drain rides the /admin/migrate endpoints end to end:
+    export (blob over the wire, keyed by the frames' req_id), import
+    (2xx ack + NDJSON continuation), commit — the stream's word cursor
+    migrates between two real socket services with zero recompute."""
+    member_cfg = EngineConfig(**TINY)
+    backends = [
+        _HttpBackend(FakeEngine(member_cfg, blocklist_path=None,
+                                token_latency_s=0.05))
+        for _ in range(2)
+    ]
+    for b in backends:
+        b.engine.start()
+    ecfg = EngineConfig(**TINY)
+    members = [HttpMember(f"h{i}", b.url, timeout_s=30, poll_period_s=0.1)
+               for i, b in enumerate(backends)]
+    router = FleetRouter(members, ecfg, blocklist_path=None,
+                         probe_period_s=0.05, eject_heartbeat_s=2.0,
+                         reprobe_backoff_s=0.2, evac_grace_s=0.5,
+                         migrate_timeout_s=10.0)
+    router.start()
+    try:
+        req = _run(router, "hm", "migrate me over http", max_tokens=16)
+        mem = _serving_member(router, req)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            f = next((f for f in list(router.flights) if f.req is req),
+                     None)
+            if f is not None and f.attempt is not None \
+                    and f.attempt.member_rid is not None \
+                    and f.attempt.n_items >= 2:
+                break
+            time.sleep(0.01)
+        router.drain_replica(mem.name)
+        items = collect(req, timeout=60)
+        assert items[-1].kind == "done"
+        words = _text(items).split()
+        assert words == [f"word{i}" for i in range(16)]
+        recs = router.journal.tail(None)
+        migrated = [r for r in recs if r["kind"] == "migrate_import"
+                    and r.get("what") != "prefix"]
+        assert migrated and migrated[-1]["replica"] == mem.name
+        assert migrated[-1]["tokens"] >= 2  # resumed mid-stream, not fresh
+        assert router.migration_count >= 1
+        assert check_no_dropped_streams(recs) == []
+    finally:
+        router.stop()
+        for b in backends:
+            b.stop()
+
+
+def test_migration_blob_wire_roundtrip():
+    import numpy as np
+
+    from ollamamq_tpu.engine import kv_cache as kvc
+
+    blob = {"version": 1, "kind": "stream", "kv_len": 9,
+            "request": {"user": "u", "generated_ids": [1, 2, 3]},
+            "k_pages": np.arange(24, dtype=np.float32).reshape(2, 3, 4),
+            "recent": np.full((8,), -1, np.int32),
+            "_inc_decode": object()}  # in-process only: dropped on pack
+    raw = kvc.pack_migration_blob(blob)
+    out = kvc.unpack_migration_blob(raw)
+    assert out["kv_len"] == 9 and out["request"]["generated_ids"] == [1, 2, 3]
+    assert np.array_equal(out["k_pages"], blob["k_pages"])
+    assert np.array_equal(out["recent"], blob["recent"])
+    assert "_inc_decode" not in out
+    with pytest.raises(ValueError):
+        kvc.unpack_migration_blob(b"not a blob")
+    # bfloat16 pools (ml_dtypes, not npz-serializable natively) survive
+    # the wire as byte views with the dtype recorded in the header.
+    import ml_dtypes
+
+    bf = np.arange(8, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    out = kvc.unpack_migration_blob(kvc.pack_migration_blob(
+        {"kind": "stream", "k_pages": bf.reshape(2, 4)}))
+    assert out["k_pages"].dtype == bf.dtype
+    assert np.array_equal(out["k_pages"], bf.reshape(2, 4))
+
+
+def test_no_dropped_streams_checker_pairs_migrations():
+    # Committed handoff: export -> import -> terminal = clean.
+    clean = [
+        {"kind": "migrate_export", "req_id": 4, "seq": 1, "tokens": 2},
+        {"kind": "migrate_import", "req_id": 4, "seq": 2},
+        {"kind": "finish", "req_id": 4, "seq": 3, "reason": "length"},
+    ]
+    assert check_no_dropped_streams(clean) == []
+    # Aborted handoff that fell back and finished = clean.
+    aborted = [
+        {"kind": "migrate_export", "req_id": 5, "seq": 1, "tokens": 2},
+        {"kind": "migrate_abort", "req_id": 5, "seq": 2, "why": "t"},
+        {"kind": "replica_failover", "req_id": 5, "seq": 3},
+        {"kind": "finish", "req_id": 5, "seq": 4, "reason": "stop"},
+    ]
+    assert check_no_dropped_streams(aborted) == []
+    # Export with no resolution AND no terminal: dropped + orphaned.
+    orphan = [
+        {"kind": "migrate_export", "req_id": 6, "seq": 1, "tokens": 2},
+    ]
+    bad = check_no_dropped_streams(orphan)
+    assert len(bad) == 2
+    assert any("DROPPED" in b for b in bad)
+    assert any("ORPHANED" in b for b in bad)
+    # Imported but never finished: dropped.
+    undone = [
+        {"kind": "migrate_export", "req_id": 7, "seq": 1, "tokens": 2},
+        {"kind": "migrate_import", "req_id": 7, "seq": 2},
+    ]
+    bad = check_no_dropped_streams(undone)
+    assert len(bad) == 1 and "DROPPED" in bad[0]
 
 
 def test_cancel_mid_stream_releases_fleet_state():

@@ -103,6 +103,12 @@ _MINIMAL = {
                              replayed_tokens=3),
     "replica_drain": dict(replica="r0", inflight=2, timeout_s=30.0),
     "replica_join": dict(replica="r1", why="heal"),
+    "migrate_export": dict(replica="r1", tokens=5, kv_len=21, pages=3,
+                           bytes=4096),
+    "migrate_import": dict(replica="r1", to_replica="r0", tokens=5,
+                           pages=3, bytes=4096),
+    "migrate_abort": dict(replica="r1", to_replica="r0",
+                          why="transfer_failed"),
 }
 
 
@@ -114,13 +120,13 @@ def test_every_kind_records_and_explains():
         text = explain(rec)
         assert isinstance(text, str) and text
     assert j.seq == len(EVENTS)
-    # The TUI line tracks the newest DECISION kind (the fleet
-    # replica_join is the last one in the vocabulary walk above);
-    # page/broadcast/rebuild bookkeeping must not displace it.
-    assert "joined rotation" in j.last_summary()
+    # The TUI line tracks the newest DECISION kind (the migration abort
+    # is the last one in the vocabulary walk above); page/broadcast/
+    # rebuild bookkeeping must not displace it.
+    assert "migration aborted" in j.last_summary()
     j.record("page_alloc", model="m", n=1, free=9, used=21, cached=1,
              pool=31)
-    assert "joined rotation" in j.last_summary()
+    assert "migration aborted" in j.last_summary()
 
 
 def test_tail_filters():
