@@ -272,6 +272,19 @@ def main() -> int:
                    help="engine replicas behind the router in the fleet "
                         "scenario's chaos leg (the golden leg always "
                         "runs one)")
+    p.add_argument("--crash-restart", type=int, default=8,
+                   help="streams in the crash_restart scenario: real "
+                        "server subprocesses (router + two HTTP member "
+                        "services, admission WAL on) with a mid-run "
+                        "kill -9 of a MEMBER (failover) and then of the "
+                        "ROUTER itself; the router restarts, recovers "
+                        "from the WAL, and clients reconnect via GET "
+                        "/api/stream/{req_id}?from=N — gated on 0 "
+                        "dropped streams, 0 silent truncations, "
+                        "recovered_streams > 0, every resumed stream "
+                        "byte-identical to the golden run, and the "
+                        "fleet-wide journal audit clean across router + "
+                        "member spills; 0 disables")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU platform (smoke-testing the harness)")
     p.add_argument("--init-timeout", type=float, default=300.0,
@@ -783,6 +796,21 @@ def main() -> int:
             print(f"# fleet scenario failed: {fleet['error']}",
                   file=sys.stderr)
 
+    # crash_restart scenario: real subprocess servers (router + two HTTP
+    # members, WAL on), kill -9 of a member mid-run (failover) and then
+    # of the router itself; restart, WAL recovery, clients reconnect via
+    # the resume endpoint — the durability acceptance run, gated on zero
+    # drops, zero silent truncations, recovered_streams > 0, and
+    # byte-identical resumed streams vs the unkilled golden leg.
+    crash_restart = None
+    if args.crash_restart > 0:
+        try:
+            crash_restart = _crash_restart_scenario(args, touch)
+        except Exception as e:  # never discard the decode numbers
+            crash_restart = {"error": f"{type(e).__name__}: {e}"}
+            print(f"# crash_restart scenario failed: "
+                  f"{crash_restart['error']}", file=sys.stderr)
+
     result = {
         "metric": "decode_tok_per_s_per_chip",
         "value": round(tok_per_s, 1),
@@ -847,6 +875,8 @@ def main() -> int:
         result["scheduling"] = scheduling
     if fleet is not None:
         result["fleet"] = fleet
+    if crash_restart is not None:
+        result["crash_restart"] = crash_restart
     run_done.set()
     print(json.dumps(result), flush=True)
     return 0
@@ -1231,6 +1261,257 @@ def _fleet_scenario(args, rng, touch):
         "elapsed_s_golden": golden["elapsed_s"],
         "elapsed_s_chaos": chaos["elapsed_s"],
     }
+
+
+def _crash_restart_scenario(args, touch):
+    """Durability acceptance at the PROCESS level: everything runs as
+    real server subprocesses (fake engines — the machinery under test
+    is the WAL/recovery/resume plumbing, not kernels). Topology: a
+    fleet router (admission WAL on, journal spilled) over two HTTP
+    member services. One seeded trace, two legs:
+
+      golden leg  N streams served untouched; texts recorded.
+      chaos leg   the same N streams; mid-run, `kill -9` a MEMBER
+                  process (PR-9/11 failover covers it, clients see one
+                  seamless stream), then `kill -9` the ROUTER itself —
+                  every client connection dies. The router restarts on
+                  the same --wal-dir, the recovery pass re-admits the
+                  unfinished streams token-exact across the surviving
+                  members, and each client reconnects with
+                  GET /api/stream/{rid}?from=N to collect the remainder.
+
+    Gates, all in-band: dropped_streams == 0, silent_truncations == 0,
+    recovered_streams > 0, every resumed stream byte-identical to its
+    golden twin, and the fleet-wide journal audit clean across the
+    union of router (pre- and post-crash) + member spills."""
+    import json as _json
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    from ollamamq_tpu.tools.journal import check_files
+
+    n = args.crash_restart
+    max_new = 14  # under the fake runtime's 16-token ceiling
+    golden_text = "".join(f"word{i} " for i in range(max_new))
+    tmp = tempfile.mkdtemp(prefix="ollamamq-crash-")
+    wal_dir = os.path.join(tmp, "wal")
+    procs = []
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def spawn(argv, log_name):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["FAKE_TOKEN_LATENCY_S"] = "0.05"
+        logf = open(os.path.join(tmp, log_name), "wb")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "ollamamq_tpu.cli"] + argv,
+            stdout=logf, stderr=subprocess.STDOUT, env=env)
+        p._logf = logf
+        procs.append(p)
+        return p
+
+    def wait_health(port, budget=90.0, want_ready=True):
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/health",
+                        timeout=2.0) as r:
+                    body = _json.loads(r.read())
+                if not want_ready or body.get("status") != "recovering":
+                    return body
+            except Exception:  # noqa: BLE001
+                pass
+            touch("crash_restart")
+            time.sleep(0.2)
+        raise RuntimeError(f"server on :{port} never became healthy")
+
+    class Client:
+        """One NDJSON stream through the router: records every frame's
+        text + token ids, notes its req_id, and survives the router
+        dying mid-read (the resume endpoint picks up from there)."""
+
+        def __init__(self, port, user, prompt):
+            self.port = port
+            self.user = user
+            self.prompt = prompt
+            self.rid = None
+            self.text = ""
+            self.ids = []
+            self.done_reason = None
+            self.thread = threading.Thread(target=self._run, daemon=True)
+            self.thread.start()
+
+        def _consume(self, resp):
+            for raw in resp:
+                obj = _json.loads(raw)
+                if obj.get("req_id") is not None:
+                    self.rid = int(obj["req_id"])
+                self.ids.extend(int(t) for t in obj.get("token_ids") or ())
+                self.text += obj.get("response", "")
+                if obj.get("done"):
+                    self.done_reason = obj.get("done_reason", "stop")
+                    return
+
+        def _run(self):
+            body = _json.dumps({
+                "model": "test-tiny", "prompt": self.prompt,
+                "stream": True, "options": {"num_predict": max_new}})
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{self.port}/api/generate",
+                data=body.encode(),
+                headers={"Content-Type": "application/json",
+                         "X-User-ID": self.user}, method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    self._consume(resp)
+            except Exception:  # noqa: BLE001 — the router died under us
+                pass
+
+        def resume(self):
+            """Reattach after the router restart: frames from the token
+            index this client already holds, byte-identical remainder."""
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{self.port}/api/stream/{self.rid}"
+                f"?from={len(self.ids)}",
+                headers={"X-User-ID": self.user}, method="GET")
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                self._consume(resp)
+
+    def run_leg(port, chaos):
+        clients = [Client(port, f"cr{i % 4}", f"crash restart {i}")
+                   for i in range(n)]
+        member_killed = not chaos
+        router_killed = not chaos
+        deadline = time.monotonic() + 300.0
+        while time.monotonic() < deadline:
+            touch("crash_restart")
+            tokens = sum(len(c.ids) for c in clients)
+            if not member_killed and tokens >= 2 * n:
+                procs[0].kill()  # member A: SIGKILL, failover territory
+                member_killed = True
+            if member_killed and not router_killed and tokens >= 6 * n \
+                    and all(c.rid is not None for c in clients):
+                # Every client holds its resume handle (the req_id its
+                # frames carried) before the router goes down.
+                router.kill()  # the router itself: the WAL's moment
+                router_killed = True
+                break
+            if all(c.done_reason is not None for c in clients):
+                break
+            time.sleep(0.05)
+        if not chaos:
+            for c in clients:
+                c.thread.join(timeout=120)
+            return clients, 0
+        for c in clients:
+            c.thread.join(timeout=30)  # reader dies with the router
+        # Restart the router on the same WAL; readiness gates on the
+        # recovery pass (status "recovering" until re-admission done).
+        restarted = spawn(router_argv(journal_tag="2"), "router2.log")
+        health = wait_health(port)
+        recovered = (health.get("wal") or {}).get("recovered_streams", 0)
+        for c in clients:
+            if c.done_reason is None and c.rid is not None:
+                c.resume()
+        return clients, recovered, restarted
+
+    # -- topology ----------------------------------------------------------
+    ports = {"a": free_port(), "b": free_port(), "router": free_port()}
+    member_argv = ["--fake-engine", "--no-tui", "--models", "test-tiny",
+                   "--blocklist", os.path.join(tmp, "bl.json")]
+    spawn(member_argv + ["--port", str(ports["a"]),
+                         "--journal-file", os.path.join(tmp, "ma.jsonl")],
+          "member_a.log")
+    spawn(member_argv + ["--port", str(ports["b"]),
+                         "--journal-file", os.path.join(tmp, "mb.jsonl")],
+          "member_b.log")
+
+    def router_argv(journal_tag=""):
+        return ["--fake-engine", "--no-tui", "--models", "test-tiny",
+                "--port", str(ports["router"]),
+                "--replicas", "0",
+                "--replica-urls",
+                f"http://127.0.0.1:{ports['a']},"
+                f"http://127.0.0.1:{ports['b']}",
+                "--wal-dir", wal_dir, "--wal-fsync-ms", "5",
+                "--journal-file",
+                os.path.join(tmp, f"router{journal_tag}.jsonl"),
+                "--blocklist", os.path.join(tmp, "bl.json")]
+
+    try:
+        wait_health(ports["a"])
+        wait_health(ports["b"])
+        router = spawn(router_argv(), "router.log")
+        wait_health(ports["router"])
+
+        golden_clients, _ = run_leg(ports["router"], chaos=False)
+        chaos_clients, recovered, router2 = run_leg(ports["router"],
+                                                    chaos=True)
+
+        dropped = sum(1 for c in chaos_clients if c.done_reason is None)
+        mismatches = [i for i, c in enumerate(chaos_clients)
+                      if c.text != golden_text]
+        silent = sum(1 for i in mismatches
+                     if golden_text.startswith(chaos_clients[i].text)
+                     and chaos_clients[i].done_reason
+                     in ("stop", "length"))
+        golden_ok = all(c.text == golden_text for c in golden_clients)
+        id_exact = all(c.ids == list(range(1, max_new + 1))
+                       for c in chaos_clients if c.done_reason)
+        # Graceful close of the restarted router flushes its spill, so
+        # the audit reads a complete journal.
+        router2.send_signal(15)
+        try:
+            router2.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            router2.kill()
+        spills = [os.path.join(tmp, f) for f in
+                  ("router.jsonl", "router2.jsonl", "ma.jsonl",
+                   "mb.jsonl")
+                  if os.path.exists(os.path.join(tmp, f))]
+        violations, audited = check_files(spills)
+        return {
+            "requests": n,
+            "max_new_tokens": max_new,
+            "recovered_streams": recovered,
+            "dropped_streams": dropped,
+            "silent_truncations": silent,
+            "stream_mismatches": len(mismatches),
+            "resumed_streams": sum(1 for c in chaos_clients
+                                   if c.rid is not None
+                                   and c.done_reason is not None),
+            "token_exact": id_exact,
+            "golden_leg_ok": golden_ok,
+            "journal_spills_audited": len(spills),
+            "journal_records_audited": audited,
+            "invariant_violations": len(violations),
+            "violations_sample": violations[:5],
+            "pass": bool(golden_ok and dropped == 0 and silent == 0
+                         and not mismatches and recovered > 0
+                         and id_exact and not violations),
+        }
+    finally:
+        for p in procs:
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                p._logf.close()
+            except Exception:  # noqa: BLE001
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _overload_scenario(rt, core, args, rng, touch):

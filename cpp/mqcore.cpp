@@ -302,6 +302,15 @@ int64_t mq_next2(mq_state *s, const char *eligible_generate,
   return task.req_id;
 }
 
+// Crash recovery (durability/): advance the request-id counter past the
+// ids a previous process generation handed out (read back from its WAL),
+// so re-admitted streams keep their old ids as stable client handles
+// while fresh requests can never collide with them.
+void mq_reserve_req_ids(mq_state *s, int64_t min_next) {
+  std::lock_guard<std::mutex> g(s->mu);
+  if (min_next > s->next_req_id) s->next_req_id = min_next;
+}
+
 int mq_cancel(mq_state *s, int64_t req_id) {
   std::lock_guard<std::mutex> g(s->mu);
   for (auto it = s->queues.begin(); it != s->queues.end(); ++it) {

@@ -25,6 +25,10 @@ set -- --no-tui --host 0.0.0.0
 [ "${MIGRATE:-}" = "false" ] && set -- "$@" --no-migrate
 [ -n "${MIGRATE_TIMEOUT_S:-}" ] && set -- "$@" --migrate-timeout-s "$MIGRATE_TIMEOUT_S"
 [ -n "${MAX_SLOTS:-}" ] && set -- "$@" --max-slots "$MAX_SLOTS"
+[ -n "${WAL_DIR:-}" ] && set -- "$@" --wal-dir "$WAL_DIR"
+[ -n "${WAL_FSYNC_MS:-}" ] && set -- "$@" --wal-fsync-ms "$WAL_FSYNC_MS"
+[ -n "${JOURNAL_SAMPLE:-}" ] && set -- "$@" --journal-sample "$JOURNAL_SAMPLE"
+[ -n "${STOP_GRACE_S:-}" ] && set -- "$@" --stop-grace-s "$STOP_GRACE_S"
 [ -n "${BLOCKLIST:-}" ] && set -- "$@" --blocklist "$BLOCKLIST"
 [ "${ALLOW_ALL_ROUTES:-}" = "true" ] && set -- "$@" --allow-all-routes
 [ "${FAKE_ENGINE:-}" = "true" ] && set -- "$@" --fake-engine

@@ -229,6 +229,42 @@ def build_parser() -> argparse.ArgumentParser:
                         "0 disables rotation")
     p.add_argument("--journal-keep", type=int, default=3,
                    help="rotated --journal-file generations kept")
+    p.add_argument("--journal-sample", type=float,
+                   default=float(os.environ.get("JOURNAL_SAMPLE", 1.0)),
+                   help="probabilistic sampling rate (0, 1] for high-"
+                        "rate journal kinds (batch/chunk/page_*/"
+                        "broadcast) so the ring and spill survive 100x "
+                        "event rates; decision-critical kinds (shed/"
+                        "preempt/finish/migrate_*/recover_*) are always "
+                        "retained. 1.0 (default) records everything; "
+                        "tools/journal check understands sampled traces")
+    # Crash durability: admission WAL + cold-restart recovery +
+    # client-resumable streams (durability/).
+    p.add_argument("--wal-dir", default=os.environ.get("WAL_DIR", ""),
+                   help="write-ahead request log directory: every "
+                        "accepted generation request is durably recorded "
+                        "(batched fsync) BEFORE the enqueue is ACKed, "
+                        "emitted tokens are logged behind it, and a "
+                        "restart replays unfinished requests token-exact "
+                        "— disconnected clients reattach via GET "
+                        "/api/stream/{req_id}?from=N. Empty = no WAL")
+    p.add_argument("--wal-fsync-ms", type=float,
+                   default=float(os.environ.get("WAL_FSYNC_MS", 20.0)),
+                   help="WAL group-commit window in ms: admissions wait "
+                        "at most this long for their covering fsync; a "
+                        "crash loses at most this much emitted-token "
+                        "progress (regenerated identically on recovery "
+                        "under greedy decoding). 0 = fsync every append")
+    p.add_argument("--no-wal", action="store_true",
+                   help="disable the admission WAL even when WAL_DIR is "
+                        "set in the environment")
+    p.add_argument("--stop-grace-s", type=float,
+                   default=float(os.environ.get("STOP_GRACE_S", 30.0)),
+                   help="graceful-shutdown budget: on SIGTERM/SIGINT the "
+                        "server stops admission, lets in-flight streams "
+                        "drain up to this long, flushes + fsyncs the "
+                        "journal and WAL, then exits 0 (stragglers stay "
+                        "in the WAL and recover on the next start)")
     p.add_argument("--metrics-buckets", default="",
                    help="comma-separated upper bounds (ms) for the latency "
                         "histograms on /metrics (ttft/tpot/step/prefill); "
@@ -303,6 +339,73 @@ def setup_logging(use_tui: bool, log_file: str = "",
                         handlers=[handler])
 
 
+def _fake_latency() -> float:
+    """Per-token delay for --fake-engine servers (env
+    FAKE_TOKEN_LATENCY_S): crash/restart and drain tests need streams
+    that stay in flight long enough for the chaos to land mid-decode."""
+    try:
+        return max(0.0, float(os.environ.get("FAKE_TOKEN_LATENCY_S", 0.0)))
+    except ValueError:
+        return 0.0
+
+
+def install_graceful_shutdown(engine, grace_s: float) -> None:
+    """SIGTERM/SIGINT => zero-drop shutdown: stop admission (new
+    enqueues shed with 503), let in-flight streams drain up to
+    `grace_s`, flush + fsync the journal and WAL, exit 0. Stragglers
+    past the grace stay recorded in the WAL (when --wal-dir is on) and
+    recover token-exact on the next start — so `docker stop` with an
+    adequate stop_grace_period drops nothing either way."""
+    import signal
+    import threading
+    import time
+
+    log = logging.getLogger("ollamamq")
+    fired = threading.Event()
+
+    def run(signum: int) -> None:
+        log.warning("signal %d: graceful shutdown — admission stopped, "
+                    "draining in-flight work (grace %.0fs)",
+                    signum, grace_s)
+        try:
+            engine.quiesce()
+        except Exception:  # noqa: BLE001
+            log.exception("quiesce failed; stopping anyway")
+        deadline = time.monotonic() + grace_s
+        while time.monotonic() < deadline:
+            try:
+                if engine.inflight_count() == 0:
+                    break
+            except Exception:  # noqa: BLE001
+                break
+            time.sleep(0.1)
+        # The engine finishing a stream and the HTTP layer flushing its
+        # final frames to the socket are asynchronous: give the event
+        # loop a moment to drain before the hard exit cuts connections.
+        time.sleep(min(1.0, max(0.0, deadline - time.monotonic())))
+        try:
+            left = engine.inflight_count()
+        except Exception:  # noqa: BLE001
+            left = -1
+        if left:
+            log.warning("grace expired with %s stream(s) still in "
+                        "flight; they remain in the WAL and recover on "
+                        "the next start", left)
+        engine.stop()  # joins the loop, fsyncs journal + WAL
+        log.warning("graceful shutdown complete; exiting 0")
+        os._exit(0)
+
+    def handler(signum, frame):  # noqa: ARG001
+        if fired.is_set():
+            os._exit(0)  # second signal: operator means NOW
+        fired.set()
+        threading.Thread(target=run, args=(signum,), daemon=True,
+                         name="graceful-shutdown").start()
+
+    signal.signal(signal.SIGTERM, handler)
+    signal.signal(signal.SIGINT, handler)
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     use_tui = not args.no_tui and sys.stdout.isatty()
@@ -330,6 +433,13 @@ def main(argv=None) -> int:
     if args.journal_rotate_mb < 0 or args.log_rotate_mb < 0:
         log.error("--journal-rotate-mb / --log-rotate-mb must be >= 0 "
                   "(0 disables rotation)")
+        return 2
+    if not (0.0 < args.journal_sample <= 1.0):
+        log.error("--journal-sample must be in (0, 1], got %s",
+                  args.journal_sample)
+        return 2
+    if args.wal_fsync_ms < 0 or args.stop_grace_s < 0:
+        log.error("--wal-fsync-ms / --stop-grace-s must be >= 0")
         return 2
     # Scheduler policy fails fast BEFORE any device work — argparse
     # doesn't validate env-supplied defaults, so a typo'd SCHEDULER env
@@ -451,6 +561,9 @@ def main(argv=None) -> int:
         journal_file=args.journal_file or None,
         journal_rotate_mb=args.journal_rotate_mb,
         journal_keep=args.journal_keep,
+        journal_sample=args.journal_sample,
+        wal_dir=(None if args.no_wal else (args.wal_dir or None)),
+        wal_fsync_ms=args.wal_fsync_ms,
         weights_dtype=args.weights_dtype,
         kv_dtype=args.kv_dtype,
         replicas=args.replicas,
@@ -477,17 +590,20 @@ def main(argv=None) -> int:
 
         # Members serve uncapped what the router placed (the router owns
         # the fleet-wide bounded-admission caps), keep no blocklist (the
-        # router blocks at ingress), and leave the journal spill to the
-        # router's fleet journal.
+        # router blocks at ingress), and leave the journal spill AND the
+        # admission WAL to the router (a member WAL would double-record
+        # and double-recover every stream).
         member_cfg = dataclasses.replace(
-            ecfg, max_queued=0, max_queued_per_user=0, journal_file=None)
+            ecfg, max_queued=0, max_queued_per_user=0, journal_file=None,
+            wal_dir=None)
         members = []
         for i in range(args.replicas):
             if args.fake_engine:
                 from ollamamq_tpu.engine.fake import FakeEngine
 
                 eng = FakeEngine(member_cfg, models=models,
-                                 blocklist_path=None, fairness=fairness)
+                                 blocklist_path=None, fairness=fairness,
+                                 token_latency_s=_fake_latency())
             else:
                 from ollamamq_tpu.engine.engine import TPUEngine
 
@@ -530,7 +646,8 @@ def main(argv=None) -> int:
         from ollamamq_tpu.engine.fake import FakeEngine
 
         engine = FakeEngine(ecfg, models=models, blocklist_path=args.blocklist,
-                            fairness=fairness)
+                            fairness=fairness,
+                            token_latency_s=_fake_latency())
     else:
         from ollamamq_tpu.engine.engine import TPUEngine
 
@@ -566,7 +683,13 @@ def main(argv=None) -> int:
 
     from aiohttp import web as aioweb
 
-    aioweb.run_app(app, host=args.host, port=args.port, print=None)
+    # Signals are ours, not aiohttp's: SIGTERM/SIGINT run the zero-drop
+    # drain (stop admission -> drain -> fsync journal+WAL -> exit 0)
+    # instead of aiohttp's immediate GracefulExit, which would cut live
+    # streams mid-generation.
+    install_graceful_shutdown(engine, args.stop_grace_s)
+    aioweb.run_app(app, host=args.host, port=args.port, print=None,
+                   handle_signals=False)
     engine.stop()
     return 0
 

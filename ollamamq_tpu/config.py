@@ -364,6 +364,27 @@ class EngineConfig:
     journal_file: Optional[str] = None
     journal_rotate_mb: float = 64.0
     journal_keep: int = 3
+    # Probabilistic sampling of high-rate journal kinds (batch/chunk/
+    # page_*/broadcast): 1.0 records everything (the default, and what
+    # the deterministic record/replay harness requires); lower rates let
+    # the ring and spill survive 100x event storms. Decision-critical
+    # kinds (enqueue/admit/shed/preempt/finish/migrate_*/recover_*/...)
+    # are ALWAYS retained regardless of the rate.
+    journal_sample: float = 1.0
+    # -- crash durability (durability/) --------------------------------------
+    # Write-ahead request log directory: every accepted generation
+    # request is durably recorded (batched fsync, --wal-fsync-ms window)
+    # BEFORE the enqueue ACKs, emitted tokens are appended behind it,
+    # and a restart replays unfinished requests token-exact — clients
+    # reattach via GET /api/stream/{req_id}?from=N. None = no WAL (the
+    # default; zero overhead). In fleet mode the ROUTER owns the WAL,
+    # like the journal spill — member configs clear it.
+    wal_dir: Optional[str] = None
+    # Group-commit fsync window in ms: every admission waits at most
+    # this long for the covering fsync; a crash loses at most this much
+    # emitted-token progress (regenerated identically under greedy
+    # decoding on recovery). 0 = fsync inline on every admission.
+    wal_fsync_ms: float = 20.0
 
     @property
     def max_context(self) -> int:

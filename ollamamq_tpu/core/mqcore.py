@@ -82,6 +82,8 @@ def _load() -> ctypes.CDLL:
                             ctypes.c_char_p, ctypes.c_int]
     lib.mq_cancel.restype = ctypes.c_int
     lib.mq_cancel.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.mq_reserve_req_ids.restype = None
+    lib.mq_reserve_req_ids.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     for name in ("mq_mark_started", "mq_block_user",
                  "mq_unblock_user", "mq_block_ip", "mq_unblock_ip",
                  "mq_set_vip", "mq_set_boost"):
@@ -258,6 +260,13 @@ class MQCore:
 
     def cancel(self, req_id: int) -> bool:
         return bool(self._lib.mq_cancel(self._h, req_id))
+
+    def reserve_req_ids(self, min_next: int) -> None:
+        """Advance the request-id counter to at least `min_next` — crash
+        recovery calls this with (max WAL rid + 1) BEFORE re-admitting,
+        so a restarted process's fresh ids never collide with the ids
+        pre-crash clients still hold (their resume handles)."""
+        self._lib.mq_reserve_req_ids(self._h, int(min_next))
 
     # -- accounting --------------------------------------------------------
     def mark_started(self, user: str) -> None:

@@ -3423,6 +3423,7 @@ class TPUEngine:
             path=engine_cfg.journal_file,
             rotate_bytes=int(engine_cfg.journal_rotate_mb * 1e6),
             keep=engine_cfg.journal_keep,
+            sample=getattr(engine_cfg, "journal_sample", 1.0),
             meta={"model": engine_cfg.model,
                   "max_slots": engine_cfg.max_slots,
                   "num_pages": engine_cfg.num_pages})
@@ -3430,6 +3431,9 @@ class TPUEngine:
         # top of every _loop_once, so a dispatch wedged inside a step
         # leaves it stale while work is pending.
         self.last_tick_at = time.monotonic()
+        # Graceful-shutdown gate: quiesce() flips it and every later
+        # enqueue sheds honestly (503) while in-flight streams drain.
+        self.accepting = True
         # Deterministic fault injection: a plan path (--fault-plan) loads
         # here — fail-fast on a malformed file — or tests hand an already
         # built FaultPlan instance via EngineConfig.fault_plan.
@@ -3441,6 +3445,15 @@ class TPUEngine:
                 FaultPlan.load(engine_cfg.fault_plan)
                 if isinstance(engine_cfg.fault_plan, str)
                 else engine_cfg.fault_plan)
+        # Crash durability (--wal-dir): admission WAL + cold-restart
+        # recovery + the resumable-stream registry. None = no overhead.
+        self.durability = None
+        if getattr(engine_cfg, "wal_dir", None):
+            from ollamamq_tpu.durability import DurabilityManager
+
+            self.durability = DurabilityManager(
+                engine_cfg, journal=self.journal, alerts=self.alerts,
+                fault_plan=self.fault_plan)
         # CPU-gloo can't run two cross-host computations concurrently: XLA's
         # CPU thread pool executes them in nondeterministic order and their
         # collective ops interleave differently per process on the shared
@@ -3531,6 +3544,15 @@ class TPUEngine:
         preemption-replay convention — so the decode continues exactly
         after them and max_tokens still budgets NEW tokens only."""
         cfg = self.ecfg
+        if not self.accepting:
+            # Graceful shutdown in progress: shed honestly while the
+            # in-flight streams drain (limit 0 = "the door is closed").
+            self._count_shed("queue_full")
+            self.journal.record(
+                "shed", user=user, model=model or None, reason="queue_full",
+                queued=self.core.total_queued(), limit=0,
+                retry_after_s=5.0, n_prompt=len(prompt_tokens or []))
+            raise QueueFullError("queue_full", 5.0, 0)
         if cfg.max_queued and self.core.total_queued() >= cfg.max_queued:
             self._count_shed("queue_full")
             retry_s = self.retry_after_s()
@@ -3577,6 +3599,12 @@ class TPUEngine:
             queued=self.core.total_queued(), kind_req=kind,
             max_tokens=req.sampling.max_tokens,
             deadline_ms=getattr(req.sampling, "deadline_ms", 0.0) or None)
+        if self.durability is not None:
+            # Durable admission: the WAL fsync must land BEFORE this
+            # enqueue is ACKed to the caller — a kill -9 after return
+            # can never lose an admitted request. The pristine prompt
+            # (pre context-fold) is what recovery re-folds from.
+            self.durability.admit(req, prompt_tokens=prompt_tokens or [])
         self.notify()
         return req
 
@@ -4045,6 +4073,11 @@ class TPUEngine:
 
             self.health = HealthMonitor(self)
             self.health.start()
+        if self.durability is not None:
+            # WAL recovery runs with the loop live (re-admissions flow
+            # through the normal enqueue path) and before the HTTP
+            # front-end starts serving — readiness is gated on it.
+            self.durability.start(self)
 
     def stop(self) -> None:
         self._running = False
@@ -4062,7 +4095,25 @@ class TPUEngine:
         if self.health is not None:
             self.health.stop()
             self.health = None
+        if self.durability is not None:
+            self.durability.close()  # final WAL flush + fsync
         self.journal.close()  # flush any --journal-file spill
+
+    def quiesce(self) -> None:
+        """Graceful-shutdown gate: stop accepting new requests (later
+        enqueues shed with 503) while everything in flight drains."""
+        self.accepting = False
+
+    def inflight_count(self) -> int:
+        """Queued + admitted-but-unfinished work — what a graceful
+        shutdown waits on before flushing and exiting."""
+        n = self.core.total_queued()
+        for rt in self._step_targets():
+            n += rt.active_count()
+            for attr in ("pending_prefill", "pending_embed", "chunking",
+                         "pending"):
+                n += len(getattr(rt, attr, ()) or ())
+        return n + len(self._migrations)
 
     @staticmethod
     def _gate_eligible(rt, kind: str) -> bool:

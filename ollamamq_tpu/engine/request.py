@@ -61,6 +61,12 @@ class TokenStream:
     def __init__(self, maxsize: int = 1024):
         self._q: "queue.Queue[StreamItem]" = queue.Queue(maxsize=maxsize)
         self.on_item: Optional[Callable[[], None]] = None
+        # Durability tap (durability/manager.py): observes every pushed
+        # item — the WAL's emitted-token log and the resumable-stream
+        # frame registry read here, WITHOUT consuming the queue (the
+        # client stream stays the sole consumer). Fires even when the
+        # queue overflows: the durable record must be complete.
+        self.tap: Optional[Callable[[StreamItem], None]] = None
         # Consumer-not-draining threshold: the engine marks the request's
         # trace with a stream_stall span when the backlog crosses this
         # (latency attribution's "stream" phase) — well before the hard
@@ -76,6 +82,12 @@ class TokenStream:
     def push(self, item: StreamItem) -> None:
         if self._closed:
             return
+        tap = self.tap
+        if tap is not None:
+            try:
+                tap(item)
+            except Exception:  # noqa: BLE001 — a broken tap must never
+                self.tap = None  # take the engine thread down with it
         try:
             self._q.put_nowait(item)
         except queue.Full:

@@ -163,6 +163,7 @@ class FleetRouter:
             path=engine_cfg.journal_file,
             rotate_bytes=int(engine_cfg.journal_rotate_mb * 1e6),
             keep=engine_cfg.journal_keep,
+            sample=getattr(engine_cfg, "journal_sample", 1.0),
             meta={"fleet": len(self.members), "placement": placement,
                   "model": engine_cfg.model})
         self.health = None
@@ -182,6 +183,18 @@ class FleetRouter:
                 FaultPlan.load(engine_cfg.fault_plan)
                 if isinstance(engine_cfg.fault_plan, str)
                 else engine_cfg.fault_plan)
+        # Graceful-shutdown gate, mirrored from TPUEngine.
+        self.accepting = True
+        # Crash durability: in fleet mode the ROUTER owns the WAL (like
+        # the journal spill); recovery re-places WAL'd streams across
+        # the surviving members through the normal placement path.
+        self.durability = None
+        if getattr(engine_cfg, "wal_dir", None):
+            from ollamamq_tpu.durability import DurabilityManager
+
+            self.durability = DurabilityManager(
+                engine_cfg, journal=self.journal, alerts=self.alerts,
+                fault_plan=self.fault_plan)
         for mem in self.members:
             self.journal.record("replica_join", replica=mem.name,
                                 why="start")
@@ -202,6 +215,10 @@ class FleetRouter:
 
             self.health = HealthMonitor(self)
             self.health.start()
+        if self.durability is not None:
+            # Fleet-wide recovery: WAL'd streams re-enter the router
+            # queue and re-place across whichever members survived.
+            self.durability.start(self)
 
     def stop(self) -> None:
         self._running = False
@@ -217,7 +234,18 @@ class FleetRouter:
                 mem.stop()
             except Exception:  # noqa: BLE001
                 log.exception("stopping member %s failed", mem.name)
+        if self.durability is not None:
+            self.durability.close()  # final WAL flush + fsync
         self.journal.close()
+
+    def quiesce(self) -> None:
+        """Graceful-shutdown gate: no new admissions; in-flight streams
+        keep draining on their members."""
+        self.accepting = False
+
+    def inflight_count(self) -> int:
+        return (self.core.total_queued() + len(self.pending)
+                + sum(1 for f in self.flights if not f.done))
 
     def notify(self) -> None:
         with self._cond:
@@ -353,6 +381,13 @@ class FleetRouter:
         `context_ids` (Ollama `context`) seeds the flight's resume state
         so the first placement already replays in token space."""
         cfg = self.ecfg
+        if not self.accepting:
+            self._count_shed("queue_full")
+            self.journal.record(
+                "shed", user=user, model=model or None, reason="queue_full",
+                queued=self.core.total_queued(), limit=0,
+                retry_after_s=5.0, n_prompt=len(prompt_tokens or []))
+            raise QueueFullError("queue_full", 5.0, 0)
         if cfg.max_queued and self.core.total_queued() >= cfg.max_queued:
             self._count_shed("queue_full")
             retry_s = self.retry_after_s()
@@ -406,6 +441,10 @@ class FleetRouter:
             queued=self.core.total_queued(), kind_req=kind,
             max_tokens=req.sampling.max_tokens,
             deadline_ms=getattr(req.sampling, "deadline_ms", 0.0) or None)
+        if self.durability is not None:
+            # Fsync-before-ACK, same contract as the single engine; the
+            # router's prompt is already pristine (members fold replay).
+            self.durability.admit(req, prompt_tokens=prompt_tokens or [])
         self.notify()
         return req
 

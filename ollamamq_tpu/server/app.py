@@ -116,6 +116,12 @@ class Server:
         r.add_route("*", "/api/pull", self.api_pull)
         r.add_route("*", "/api/push", self.api_push)
         r.add_route("*", "/api/blobs/{digest}", self.api_blobs)
+        # Client-resumable streams (only with --wal-dir durability): a
+        # disconnected client — including one cut off by a server crash
+        # + restart — reattaches by the req_id its NDJSON frames carried
+        # and receives the remainder byte- and token-identical.
+        if getattr(self.engine, "durability", None) is not None:
+            r.add_route("GET", "/api/stream/{req_id}", self.api_stream_resume)
         r.add_route("*", "/api/ps", self.api_ps)
         r.add_route("*", "/api/version", self.api_version)
         r.add_route("*", "/v1/chat/completions", self.v1_chat_completions)
@@ -336,10 +342,19 @@ class Server:
         if alerts is None:
             return web.json_response({"status": "ok", "alerts": []})
         active = [a.to_dict() for a in alerts.active()]
-        return web.json_response({
-            "status": "degraded" if active else "ok",
-            "alerts": active,
-        })
+        status = "degraded" if active else "ok"
+        payload = {"status": status, "alerts": active}
+        dur = getattr(self.engine, "durability", None)
+        if dur is not None:
+            # Readiness gating: while the WAL recovery pass is still
+            # re-admitting, the process is up but not ready — an LB/
+            # orchestrator keying on "ok" holds traffic until the
+            # recovered streams are back in the queue.
+            wal = dur.status()
+            payload["wal"] = wal
+            if wal.get("recovering"):
+                payload["status"] = "recovering"
+        return web.json_response(payload)
 
     async def root(self, request: web.Request) -> web.Response:
         # Ollama answers its root with this exact liveness string; clients
@@ -892,6 +907,88 @@ class Server:
         except (ConnectionResetError, asyncio.CancelledError):
             # Client went away mid-stream: cancel + reclaim (dropped count).
             self.engine.cancel(req.req_id)
+            raise
+        await resp.write_eof()
+        return resp
+
+    # ------------------------------------------------- resumable streams
+    async def api_stream_resume(self, request: web.Request):
+        """Reattach to a stream by the `req_id` its NDJSON frames
+        carried: replay every frame from token index `?from=N` (default
+        0) out of the durability registry's frame log, then follow live
+        until the stream's terminal. Works across a server restart —
+        the WAL recovery pass re-admits unfinished streams under their
+        ORIGINAL ids — and the replayed remainder is byte- and
+        token-identical to what an uninterrupted run would have sent.
+        This is an observer: disconnecting from it never cancels the
+        underlying request."""
+        self._ident(request)
+        dur = self.engine.durability  # route only exists when attached
+        try:
+            rid = int(request.match_info["req_id"])
+        except ValueError:
+            raise ApiError(400, "request id must be an integer")
+        try:
+            from_n = int(request.query.get("from", "0"))
+        except ValueError:
+            raise ApiError(400, "'from' must be an integer token index")
+        if from_n < 0:
+            raise ApiError(400, "'from' must be >= 0")
+        entry = dur.registry.find(rid)
+        if entry is None:
+            raise ApiError(404, f"no resumable stream for request {rid} "
+                                "(unknown id, or expired from the "
+                                "stream archive)")
+        model = ""
+        resp = web.StreamResponse()
+        resp.content_type = "application/x-ndjson"
+        await resp.prepare(request)
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.timeout_s
+        sent = 0          # frames consumed from the entry
+        tokens_seen = 0   # id-carrying frames passed (the ?from cursor)
+        try:
+            while True:
+                frames, terminal = entry.snapshot(sent)
+                for tid, text in frames:
+                    sent += 1
+                    if tokens_seen < from_n:
+                        # Still inside the prefix the client already
+                        # has: count and skip.
+                        if tid >= 0:
+                            tokens_seen += 1
+                        continue
+                    if tid >= 0:
+                        tokens_seen += 1
+                    p = {"model": model, "created_at": _now_iso(),
+                         "done": False, "req_id": entry.rid,
+                         "response": text}
+                    if tid >= 0:
+                        p["token_ids"] = [tid]
+                    await resp.write((json.dumps(p) + "\n").encode())
+                # A set terminal is final: the registry rejects frame
+                # appends after it, and the snapshot is atomic — every
+                # frame has been sent by the time we get here.
+                if terminal is not None:
+                    reason = terminal.get("reason", "stop")
+                    p = {"model": model, "created_at": _now_iso(),
+                         "done": True, "req_id": entry.rid,
+                         "done_reason": reason, "response": ""}
+                    if terminal.get("error"):
+                        p["error"] = terminal["error"]
+                    await resp.write((json.dumps(p) + "\n").encode())
+                    break
+                if loop.time() > deadline:
+                    await resp.write((json.dumps(
+                        {"model": model, "created_at": _now_iso(),
+                         "done": True, "req_id": entry.rid,
+                         "done_reason": "error",
+                         "error": "resume timeout"}) + "\n").encode())
+                    break
+                await asyncio.sleep(0.02)
+        except (ConnectionResetError, asyncio.CancelledError):
+            # Resume reader gone: the underlying stream keeps running
+            # (it can be resumed again); nothing to cancel.
             raise
         await resp.write_eof()
         return resp

@@ -32,6 +32,7 @@ from __future__ import annotations
 import collections
 import json
 import os
+import random
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -77,6 +78,10 @@ EVENTS = (
     "migrate_import",    # target installed the shipped state (the ack)
     "migrate_abort",     # transfer failed; source state released and the
     #                      stream falls back to recompute replay
+    # Crash durability (durability/): the admission WAL + cold-restart
+    # recovery.
+    "wal_admit",         # request durably logged (fsynced) pre-ACK
+    "recover_replay",    # WAL'd unfinished request re-admitted at start
 )
 
 # kind -> (required fields, optional fields) beyond the common header
@@ -152,6 +157,14 @@ EVENT_FIELDS: Dict[str, Tuple[tuple, tuple]] = {
                        ("replica", "to_replica", "tokens", "pages",
                         "bytes", "what")),
     "migrate_abort": (("why",), ("replica", "to_replica")),
+    # WAL records carry the durability cost (how long the admission
+    # waited on its covering fsync) and the recovery inputs (how many
+    # already-emitted tokens the replay restored without recompute).
+    "wal_admit": (("fsync_ms",), ("n_prompt",)),
+    # `req_id` is the RE-ADMITTED id (what the rest of this journal's
+    # records use); `wal_rid` is the pre-crash id the client still
+    # holds — the resume endpoint aliases the two.
+    "recover_replay": (("tokens",), ("outcome", "n_prompt", "wal_rid")),
 }
 assert set(EVENT_FIELDS) == set(EVENTS)
 
@@ -167,7 +180,17 @@ DECISION_KINDS = ("enqueue", "admit", "sched", "place", "shed", "batch",
                   "install", "preempt", "requeue", "retry", "poison",
                   "deadline_drop", "finish", "replica_eject",
                   "replica_failover", "replica_drain", "replica_join",
-                  "migrate_export", "migrate_import", "migrate_abort")
+                  "migrate_export", "migrate_import", "migrate_abort",
+                  "recover_replay")
+
+# High-rate bookkeeping kinds eligible for probabilistic sampling
+# (--journal-sample < 1): each record is self-contained (page events
+# carry their full post-state), so a sampled trace stays checkable —
+# only the batch-ordinal starvation count loses meaning (tools/journal
+# check skips it on sampled traces). Decision-critical kinds are never
+# sampled out.
+SAMPLED_KINDS = frozenset({"batch", "chunk", "page_alloc", "page_free",
+                           "page_evict", "broadcast"})
 
 # Per-kind fields folded into the replay signature (deterministic given
 # the same arrivals; excludes timestamps, latencies, and page ids).
@@ -198,8 +221,13 @@ class Journal:
 
     def __init__(self, capacity: int = 2048, path: Optional[str] = None,
                  rotate_bytes: int = 64_000_000, keep: int = 3,
-                 meta: Optional[dict] = None):
+                 meta: Optional[dict] = None, sample: float = 1.0):
         self.capacity = max(1, int(capacity))
+        # Probabilistic sampling of SAMPLED_KINDS (--journal-sample):
+        # seeded so two runs of the same trace sample identically.
+        self.sample = min(1.0, max(0.0, float(sample)))
+        self._sample_rng = random.Random(0)
+        self.sampled_out = 0
         self._ring: collections.deque = collections.deque(
             maxlen=self.capacity)
         self._lock = threading.Lock()
@@ -212,6 +240,10 @@ class Journal:
         self.rotate_bytes = max(0, int(rotate_bytes))
         self.keep = max(1, int(keep))
         self.meta = dict(meta or {})
+        if self.sample < 1.0:
+            # The spill must say it is sampled: the offline checker
+            # reads this to skip batch-ordinal-dependent invariants.
+            self.meta.setdefault("sample", self.sample)
         self._fh = None
         self._bytes = 0
         self._last_decision: Optional[dict] = None
@@ -271,6 +303,16 @@ class Journal:
         if sets is None:
             raise JournalError(f"unknown journal event kind {kind!r} "
                                f"(vocabulary: {EVENTS})")
+        if self.sample < 1.0 and kind in SAMPLED_KINDS:
+            # Sampled journaling: high-rate bookkeeping kinds keep the
+            # ring and spill alive at 100x event rates; the metric still
+            # counts every event so rates stay readable off /metrics.
+            with self._lock:
+                keep = self._sample_rng.random() < self.sample
+            if not keep:
+                self.sampled_out += 1
+                self._tm[kind].inc()
+                return {}
         required, allowed = sets
         got = frozenset(fields)
         if not required <= got:
@@ -338,9 +380,13 @@ class Journal:
     def snapshot(self) -> dict:
         with self._lock:
             size = len(self._ring)
-        return {"capacity": self.capacity, "size": size, "seq": self.seq,
-                "evicted": max(0, self.seq - size),
-                "file": self.path, "tick": self.tick}
+        out = {"capacity": self.capacity, "size": size, "seq": self.seq,
+               "evicted": max(0, self.seq - size),
+               "file": self.path, "tick": self.tick}
+        if self.sample < 1.0:
+            out["sample"] = self.sample
+            out["sampled_out"] = self.sampled_out
+        return out
 
     def last_summary(self) -> str:
         """One-line text of the most recent scheduler decision (the TUI
@@ -508,6 +554,15 @@ def explain(rec: dict) -> str:
         if rec.get("replica"):
             s += f" on replica {rec['replica']}"
         return s + "; falling back to recompute replay"
+    if kind == "wal_admit":
+        return (f"{who} durably WAL'd pre-ACK "
+                f"(fsync wait {rec.get('fsync_ms', '?')}ms, "
+                f"{rec.get('n_prompt', '?')} prompt tokens)")
+    if kind == "recover_replay":
+        return (f"{who} recovered from the WAL at restart "
+                f"({rec.get('outcome', 'replayed')}: "
+                f"{rec.get('tokens', '?')} already-emitted token(s) "
+                "restored without recompute)")
     return f"{kind} {who}"
 
 
@@ -538,7 +593,8 @@ STARVATION_BATCHES = 50
 
 
 def check_invariants(records: List[dict],
-                     starve_after: int = STARVATION_BATCHES) -> List[str]:
+                     starve_after: Optional[int] = STARVATION_BATCHES
+                     ) -> List[str]:
     """Returns violation strings (empty = clean). Checked invariants:
 
       1. pages conserved — every page event's post-state satisfies
@@ -553,6 +609,11 @@ def check_invariants(records: List[dict],
          without progress (install/finish/requeue/retry/shed/preempt);
       6. speculation never accepts more than it proposed — a spec_verify
          with accepted > proposed fabricated tokens.
+
+    `starve_after=None` skips check 5 — sampled journals
+    (--journal-sample < 1) drop a fraction of `batch` records, so the
+    batch-ordinal starvation clock under-counts and cannot be trusted;
+    every other check reads self-contained records and stays valid.
     """
     bad: List[str] = []
     # (model, slot) -> req_id currently observed holding it.
@@ -617,6 +678,8 @@ def check_invariants(records: List[dict],
             admitted[rid] = batches
         elif kind in progress and rid is not None:
             admitted.pop(rid, None)
+    if starve_after is None:
+        return bad
     for rid, at_batch in admitted.items():
         if batches - at_batch >= starve_after:
             bad.append(

@@ -248,6 +248,22 @@ FLEET_MIGRATE_BYTES_TOTAL = REGISTRY.counter(
     "KV page payload bytes shipped between fleet members (migrations "
     "and prefix shipping; int8 pools move ~2x fewer bytes than bf16)")
 
+# -- crash durability (durability/; --wal-dir) -----------------------------
+WAL_FSYNC_MS = REGISTRY.histogram(
+    "ollamamq_wal_fsync_ms",
+    "Admission-WAL fsync latency (ms): how long the group-commit window "
+    "plus the fsync itself held each durable write — the durability tax "
+    "every ACKed enqueue pays under --wal-dir",
+    buckets=(0.1, 0.5, 1, 2, 5, 10, 20, 50, 100, 250, 1000))
+RECOVERED_STREAMS_TOTAL = REGISTRY.counter(
+    "ollamamq_recovered_streams_total",
+    "WAL'd requests handled by the cold-restart recovery pass, by "
+    "outcome: 'replayed' (re-admitted token-exact with generated_ids "
+    "pre-filled), 'finished' (budget already spent — only the terminal "
+    "was surfaced), 'failed' (re-admission errored; the stream ends "
+    "with an explicit error, never a silent drop)",
+    labels=("outcome",))
+
 # -- host / device ---------------------------------------------------------
 HBM_USED_BYTES = REGISTRY.gauge(
     "ollamamq_hbm_used_bytes",

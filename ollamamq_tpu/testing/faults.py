@@ -42,7 +42,12 @@ Each rule names ONE site and ONE trigger:
            router's --migrate-timeout-s budget it aborts — and
            "device_loss" kills the SOURCE member right after export,
            exercising the orphaned-export half of the two-phase
-           handoff).
+           handoff), or the durability WAL's flush seam ("wal", checked
+           before each batched write+fsync: "exception" simulates disk
+           trouble and DEGRADES the WAL loudly — serving continues
+           without crash durability, the wal_degraded alert fires —
+           and "slow" stalls the fsync, stretching the admission-ACK
+           latency the group commit is supposed to bound).
   kind     "exception"  -> the dispatch raises FaultInjected (the
                            engine's retry/containment path handles it);
            "slow"       -> the dispatch sleeps delay_s first (stall
@@ -79,7 +84,7 @@ from typing import Dict, List, Optional
 
 SITES = ("prefill", "chunk", "sp_prefill", "ragged", "spec_verify",
          "decode", "embed", "encode", "step", "alloc", "extend", "replica",
-         "migrate")
+         "migrate", "wal")
 KINDS = ("exception", "slow", "alloc_fail", "device_loss")
 
 _RULE_KEYS = {"site", "kind", "at", "every", "p", "times", "delay_s",

@@ -8,10 +8,19 @@ by `record`):
     tail FILE      raw records (filters: --n/--req-id/--user/--kind)
     explain FILE   per-decision human explanations (same filters)
     stats FILE     batch occupancy + padding-waste + fair-share audit
-    check FILE     invariant checker (exit 1 on any violation); fleet
+    check FILE...  invariant checker (exit 1 on any violation); fleet
                    journals additionally pin zero-drop: every stream a
                    replica_eject/replica_failover touched must reach a
-                   terminal record (check_no_dropped_streams)
+                   terminal record (check_no_dropped_streams), and each
+                   recovered/migrated stream exactly ONE terminal
+                   (check_stream_attribution). Multiple files run the
+                   audit across the union — the fleet roll-up: pass the
+                   router's spill AND every member's. Sampled spills
+                   (--journal-sample < 1) are detected off the journal
+                   meta; the batch-ordinal starvation check is skipped
+                   for them (batch records are sampled), everything
+                   else — page conservation, slot assignment, zero-drop
+                   — reads self-contained records and stays binding.
 
 Record/replay (the determinism acceptance loop):
 
@@ -54,7 +63,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from ollamamq_tpu.config import SCHEDULERS
 from ollamamq_tpu.telemetry.journal import (EVENTS, Journal, batch_stats,
@@ -91,9 +100,10 @@ def check_no_dropped_streams(records: List[dict]) -> List[str]:
     router request id — stable across failovers, requeues, and
     migrations — so the audit is a straight pairing:
 
-      - a `replica_failover` / `migrate_export` / `migrate_import` whose
-        req never reaches finish / shed / deadline_drop / poison by the
-        end of the journal is a dropped stream;
+      - a `replica_failover` / `migrate_export` / `migrate_import` /
+        `recover_replay` (outcome "replayed") whose req never reaches
+        finish / shed / deadline_drop / poison by the end of the journal
+        is a dropped stream;
       - a `migrate_export` resolved by NEITHER `migrate_import` nor
         `migrate_abort` nor a terminal for its req is an orphaned
         two-phase handoff (source state parked forever).
@@ -101,28 +111,11 @@ def check_no_dropped_streams(records: List[dict]) -> List[str]:
     Run this on COMPLETE journals (a finished bench/chaos run, a drained
     spill) — a live ring mid-failover would report in-flight streams as
     violations, which is why this lives here and not in the health
-    monitor's live invariant sweep."""
-    pending: dict = {}  # rid -> seq of the last failover/migration touch
-    open_handoff: dict = {}  # rid -> seq of an unresolved migrate_export
-    terminal = ("finish", "shed", "deadline_drop", "poison")
-    for r in records:
-        kind = r.get("kind")
-        rid = r.get("req_id")
-        if rid is None:
-            continue
-        if kind == "replica_failover":
-            pending[rid] = r.get("seq", "?")
-        elif kind == "migrate_export":
-            pending[rid] = r.get("seq", "?")
-            open_handoff[rid] = r.get("seq", "?")
-        elif kind == "migrate_import":
-            pending[rid] = r.get("seq", "?")
-            open_handoff.pop(rid, None)
-        elif kind == "migrate_abort":
-            open_handoff.pop(rid, None)
-        elif kind in terminal:
-            pending.pop(rid, None)
-            open_handoff.pop(rid, None)
+    monitor's live invariant sweep. A journal cut short by a process
+    crash legitimately leaves touched streams pending — the multi-file
+    `check` roll-up resolves those against the RESTARTED process's
+    spill (a `recover_replay` whose wal_rid names the cut stream)."""
+    pending, open_handoff = _dropped_streams(records)
     bad = [
         f"req {rid} stream DROPPED: replica_failover/migration at seq {seq}"
         " with no terminal record (finish/shed/deadline_drop/poison) by "
@@ -135,6 +128,67 @@ def check_no_dropped_streams(records: List[dict]) -> List[str]:
         for rid, seq in sorted(open_handoff.items())
     ]
     return bad
+
+
+def _dropped_streams(records: List[dict]) -> Tuple[dict, dict]:
+    """(pending, open_handoff) rid->seq maps behind the zero-drop audit."""
+    pending: dict = {}  # rid -> seq of the last failover/migration touch
+    open_handoff: dict = {}  # rid -> seq of an unresolved migrate_export
+    terminal = ("finish", "shed", "deadline_drop", "poison")
+    for r in records:
+        kind = r.get("kind")
+        rid = r.get("req_id")
+        if rid is None:
+            continue
+        if kind == "replica_failover":
+            pending[rid] = r.get("seq", "?")
+        elif kind == "recover_replay" and r.get("outcome") == "replayed":
+            # The WAL zero-drop contract: a recovered stream must reach
+            # its terminal like any other (outcome "finished"/"failed"
+            # records ARE the terminal story for their streams).
+            pending[rid] = r.get("seq", "?")
+        elif kind == "migrate_export":
+            pending[rid] = r.get("seq", "?")
+            open_handoff[rid] = r.get("seq", "?")
+        elif kind == "migrate_import":
+            pending[rid] = r.get("seq", "?")
+            open_handoff.pop(rid, None)
+        elif kind == "migrate_abort":
+            open_handoff.pop(rid, None)
+        elif kind in terminal:
+            pending.pop(rid, None)
+            open_handoff.pop(rid, None)
+    return pending, open_handoff
+
+
+def check_stream_attribution(records: List[dict]) -> List[str]:
+    """Every stream a recovery touched must reach exactly ONE terminal:
+    a failed-over/migrated/WAL-recovered stream with two `finish`
+    records was served twice (a zombie attempt survived its handoff),
+    one with zero is a drop (check_no_dropped_streams reports those).
+    Keyed per journal: request-id spaces are process-local, so callers
+    merging multiple spills run this per file, not on the raw union."""
+    touched = set()
+    finishes: dict = {}
+    for r in records:
+        rid = r.get("req_id")
+        if rid is None:
+            continue
+        kind = r.get("kind")
+        if kind in ("replica_failover", "migrate_export") \
+                or (kind == "recover_replay"
+                    and r.get("outcome") == "replayed") \
+                or (kind == "migrate_import" and r.get("what") != "prefix"):
+            touched.add(rid)
+        elif kind == "finish":
+            finishes[rid] = finishes.get(rid, 0) + 1
+    return [
+        f"req {rid} has {finishes[rid]} terminal finish records: a "
+        "recovered/migrated stream must be attributed to exactly one "
+        "terminal"
+        for rid in sorted(touched)
+        if finishes.get(rid, 0) > 1
+    ]
 
 
 def _gen_arrivals(seed: int, n: int) -> List[dict]:
@@ -468,21 +522,86 @@ def _cmd_stats(args) -> int:
     return 0
 
 
+def check_files(paths: List[str]) -> Tuple[List[str], int]:
+    """The fleet-wide audit roll-up over one or more spills (router +
+    member journals of one run). Per-spill: the invariant checker
+    (starvation skipped on sampled traces — batch records are sampled;
+    everything else reads self-contained records and stays binding),
+    the zero-drop audit, and the exactly-one-terminal attribution.
+    Across the union: a stream left pending by a spill that ends in a
+    process crash is resolved by the RESTARTED process's spill when a
+    `recover_replay` names it via wal_rid — that is the WAL zero-drop
+    contract spanning the crash. Returns (violations, total_records)."""
+    from ollamamq_tpu.telemetry.journal import STARVATION_BATCHES
+
+    loaded = []
+    notes: List[str] = []
+    per_file_recovered: List[set] = []
+    for path in paths:
+        meta, records = load_jsonl(path)
+        sampled = float(meta.get("sample") or 1.0) < 1.0
+        loaded.append((path, records, sampled))
+        per_file_recovered.append({
+            int(r["wal_rid"]) for r in records
+            if r.get("kind") == "recover_replay"
+            and r.get("wal_rid") is not None
+            and r.get("outcome") in ("replayed", "finished")})
+    bad: List[str] = []
+    total = 0
+    for idx, (path, records, sampled) in enumerate(loaded):
+        tag = f"{path}: " if len(paths) > 1 else ""
+        total += len(records)
+        # Cross-crash resolution set: wal_rids recovered by OTHER spills
+        # (a restarted process's journal resolves the crashed one's cut
+        # streams — never its own: rid counters restart at 1, so a
+        # spill's own wal_rids can collide with its fresh rids).
+        recovered_wal_rids = set().union(
+            *(s for j, s in enumerate(per_file_recovered) if j != idx),
+            set())
+        if sampled:
+            notes.append(f"{tag}sampled trace (journal meta): "
+                         "batch-ordinal starvation check skipped, all "
+                         "other invariants binding")
+        bad += [tag + v for v in check_invariants(
+            records, starve_after=None if sampled else STARVATION_BATCHES)]
+        if not any(r.get("kind", "").startswith(("replica_", "migrate_",
+                                                 "recover_"))
+                   for r in records):
+            continue
+        pending, open_handoff = _dropped_streams(records)
+        for rid, seq in sorted(pending.items()):
+            if rid in recovered_wal_rids:
+                continue  # resolved across the crash by WAL recovery
+            bad.append(
+                f"{tag}req {rid} stream DROPPED: replica_failover/"
+                f"migration/recovery at seq {seq} with no terminal "
+                "record by journal end and no recover_replay for it in "
+                "any companion spill")
+        bad += [
+            f"{tag}req {rid} migration ORPHANED: migrate_export at seq "
+            f"{seq} never resolved by migrate_import/migrate_abort or a "
+            "terminal record"
+            for rid, seq in sorted(open_handoff.items())
+        ]
+        bad += [tag + v for v in check_stream_attribution(records)]
+    for n in notes:
+        print(n)
+    return bad, total
+
+
 def _cmd_check(args) -> int:
-    _meta, records = load_jsonl(args.file)
-    bad = check_invariants(records)
-    # Fleet runs additionally pin zero-drop: only meaningful when the
-    # journal saw fleet events at all (single-engine journals skip it).
-    if any(r.get("kind", "").startswith("replica_") for r in records):
-        bad = bad + check_no_dropped_streams(records)
+    files = args.file if isinstance(args.file, list) else [args.file]
+    bad, total = check_files(files)
     if bad:
         print(f"{len(bad)} invariant violation(s):", file=sys.stderr)
         for b in bad:
             print(f"  - {b}", file=sys.stderr)
         return 1
-    print(f"ok: {len(records)} records, all invariants hold "
+    scope = (f"{len(files)} journal(s), " if len(files) > 1 else "")
+    print(f"ok: {scope}{total} records, all invariants hold "
           "(pages conserved, no slot double-assignment, victim never VIP, "
-          "sheds only over bounds, no starvation, no dropped streams)")
+          "sheds only over bounds, no starvation, no dropped streams, "
+          "every recovered stream attributed to exactly one terminal)")
     return 0
 
 
@@ -572,11 +691,16 @@ def build_parser() -> argparse.ArgumentParser:
         sp = sub.add_parser(name)
         add_filters(sp)
         sp.set_defaults(fn=fn)
-    for name, fn in (("stats", _cmd_stats), ("check", _cmd_check),
-                     ("replay", _cmd_replay)):
+    for name, fn in (("stats", _cmd_stats), ("replay", _cmd_replay)):
         sp = sub.add_parser(name)
         sp.add_argument("file")
         sp.set_defaults(fn=fn)
+    sp = sub.add_parser("check")
+    sp.add_argument("file", nargs="+",
+                    help="one or more spilled journals; several run the "
+                         "fleet-wide roll-up (router + member spills "
+                         "audited as one run)")
+    sp.set_defaults(fn=_cmd_check)
     sp = sub.add_parser("record")
     sp.add_argument("file")
     sp.add_argument("--seed", type=int, default=0)

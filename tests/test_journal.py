@@ -109,6 +109,9 @@ _MINIMAL = {
                            pages=3, bytes=4096),
     "migrate_abort": dict(replica="r1", to_replica="r0",
                           why="transfer_failed"),
+    "wal_admit": dict(fsync_ms=1.25, n_prompt=16),
+    "recover_replay": dict(tokens=5, outcome="replayed", n_prompt=16,
+                           wal_rid=3),
 }
 
 
@@ -120,13 +123,13 @@ def test_every_kind_records_and_explains():
         text = explain(rec)
         assert isinstance(text, str) and text
     assert j.seq == len(EVENTS)
-    # The TUI line tracks the newest DECISION kind (the migration abort
+    # The TUI line tracks the newest DECISION kind (the recovery replay
     # is the last one in the vocabulary walk above); page/broadcast/
     # rebuild bookkeeping must not displace it.
-    assert "migration aborted" in j.last_summary()
+    assert "recovered from the WAL" in j.last_summary()
     j.record("page_alloc", model="m", n=1, free=9, used=21, cached=1,
              pool=31)
-    assert "migration aborted" in j.last_summary()
+    assert "recovered from the WAL" in j.last_summary()
 
 
 def test_tail_filters():
