@@ -55,6 +55,7 @@ def _fallback_argv(model: str, dtypes=("bfloat16", "bfloat16"),
            "--shared-prefix-tail", "16",
            "--slo-burst", "2", "--slo-burst-size", "4",
            "--overload", "16", "--density", "8", "--scheduling", "16",
+           "--tiering", "16",
            "--init-timeout", "300"]
 
 
@@ -272,6 +273,16 @@ def main() -> int:
                    help="engine replicas behind the router in the fleet "
                         "scenario's chaos leg (the golden leg always "
                         "runs one)")
+    p.add_argument("--tiering", type=int, default=32,
+                   help="interactive requests in the tiering scenario "
+                        "(0 disables): a seeded bimodal VIP/bulk trace "
+                        "through a 2-tier fleet vs homogeneous fleets "
+                        "at equal member count — per-tier p50/p99 TTFT, "
+                        "aggregate tok/s, overflow/regroup counts, 0 "
+                        "dropped streams, and a clean multi-spill "
+                        "journal audit; pass gate: tiered <= the "
+                        "latency-viable homogeneous fleet on p99 "
+                        "interactive TTFT AND >= on aggregate tok/s")
     p.add_argument("--crash-restart", type=int, default=8,
                    help="streams in the crash_restart scenario: real "
                         "server subprocesses (router + two HTTP member "
@@ -796,6 +807,22 @@ def main() -> int:
             print(f"# fleet scenario failed: {fleet['error']}",
                   file=sys.stderr)
 
+    # tiering scenario: the same seeded bimodal VIP/bulk trace through a
+    # 2-tier fleet (latency-grade interactive member + throughput-grade
+    # bulk member) and through homogeneous fleets at equal member count;
+    # gate: tiered <= the latency-viable homogeneous fleet on p99
+    # interactive TTFT AND >= on aggregate tok/s, zero dropped streams,
+    # clean multi-spill journal audit — plus a balancer regroup
+    # exercise (class-mix shift -> drain -> migrate -> rejoin).
+    tiering = None
+    if args.tiering > 0:
+        try:
+            tiering = _tiering_scenario(args, rng, touch)
+        except Exception as e:  # never discard the decode numbers
+            tiering = {"error": f"{type(e).__name__}: {e}"}
+            print(f"# tiering scenario failed: {tiering['error']}",
+                  file=sys.stderr)
+
     # crash_restart scenario: real subprocess servers (router + two HTTP
     # members, WAL on), kill -9 of a member mid-run (failover) and then
     # of the router itself; restart, WAL recovery, clients reconnect via
@@ -875,6 +902,8 @@ def main() -> int:
         result["scheduling"] = scheduling
     if fleet is not None:
         result["fleet"] = fleet
+    if tiering is not None:
+        result["tiering"] = tiering
     if crash_restart is not None:
         result["crash_restart"] = crash_restart
     run_done.set()
@@ -1260,6 +1289,230 @@ def _fleet_scenario(args, rng, touch):
         "migration": migration,
         "elapsed_s_golden": golden["elapsed_s"],
         "elapsed_s_chaos": chaos["elapsed_s"],
+    }
+
+
+def _tiering_scenario(args, rng, touch):
+    """Tiered-fleet acceptance (Nitsum): the SAME seeded bimodal trace —
+    deadlined interactive shorts paced through a window, a bulk backlog
+    of long generations — runs through
+
+      (a) the TIERED fleet: one latency-grade member (few slots, fast
+          steps) serving `interactive`, one throughput-grade member
+          (many slots, slower steps — the big-batch config) serving
+          `bulk`, with per-tier burn-rate overflow ON so bulk backlog
+          may spill into interactive headroom;
+      (b) the latency-viable HOMOGENEOUS fleet at equal member count:
+          both members latency-grade — what an operator bound by the
+          interactive SLO must deploy without tiers (Nitsum's
+          comparator); and
+      (c) the throughput-grade homogeneous fleet, reported for the full
+          tradeoff picture (it wins raw tok/s but blows the interactive
+          p99 — the tradeoff tiering escapes).
+
+    Readout: per-tier p50/p99 TTFT, aggregate tok/s, overflow/regroup
+    counts, dropped streams, invariant violations, and the multi-spill
+    journal audit (router + both members' spills through tools/journal
+    check_files). Gate: tiered <= leg (b) on p99 interactive TTFT AND
+    >= on aggregate tok/s, zero drops, clean audit. A separate
+    3-member regroup exercise shifts the class mix and lets the
+    TierBalancer retier a member (drain -> migrate -> rejoin),
+    journaled tier_regroup start -> done."""
+    import dataclasses
+    import os
+    import tempfile
+    import time
+
+    from ollamamq_tpu.config import EngineConfig
+    from ollamamq_tpu.engine.fake import FakeEngine
+    from ollamamq_tpu.fleet import FleetRouter, LocalMember
+    from ollamamq_tpu.ops.sampling import SamplingParams
+    from ollamamq_tpu.telemetry.journal import check_invariants
+    from ollamamq_tpu.tools.journal import check_files
+
+    n_short = args.tiering
+    # Bulk sized to outlast the interactive window: the backlog's tail
+    # drains after the shorts stop, which is exactly when burn-driven
+    # overflow finds idle interactive headroom to spill into.
+    n_bulk = max(6, (n_short * 5) // 4)
+    short_toks, bulk_toks = 2, 16
+    window_s = 1.0  # interactive pacing window
+    # Member grades: the real big-batch tradeoff modeled on the fake —
+    # throughput-grade runs many slots at a slower step (higher
+    # aggregate tok/s, worse latency), latency-grade few slots fast.
+    lat_grade = dict(max_slots=2, latency=0.01)
+    thr_grade = dict(max_slots=12, latency=0.03)
+    base_kw = dict(model="test-tiny", num_pages=64, page_size=8,
+                   max_pages_per_seq=8, decode_steps_per_iter=2)
+    tmp = tempfile.mkdtemp(prefix="ollamamq-tiering-")
+
+    def run_leg(tag, grades, tiers_spec):
+        ecfg = EngineConfig(
+            max_slots=max(g["max_slots"] for g in grades),
+            journal_file=os.path.join(tmp, f"{tag}-router.jsonl"),
+            tiers=tiers_spec, **base_kw)
+        members = []
+        spills = [ecfg.journal_file]
+        for i, grade in enumerate(grades):
+            mcfg = dataclasses.replace(
+                ecfg, max_slots=grade["max_slots"], tiers=None,
+                journal_file=os.path.join(tmp, f"{tag}-r{i}.jsonl"))
+            spills.append(mcfg.journal_file)
+            members.append(LocalMember(
+                f"r{i}", FakeEngine(mcfg, blocklist_path=None,
+                                    token_latency_s=grade["latency"])))
+        router = FleetRouter(
+            members, ecfg, blocklist_path=None, probe_period_s=0.05,
+            eject_heartbeat_s=5.0, reprobe_backoff_s=0.2,
+            evac_grace_s=1.0,
+            # Overflow windows shrunk to the smoke's timescale so bulk
+            # backlog (bulk-tier TTFT burn) can spill into interactive
+            # headroom within the run; untiered legs ignore this.
+            tiering_kw=dict(windows=(("fast", 5.0, 1.0, 1.0, "warn"),),
+                            bulk_ttft_ms=150.0, balance=False))
+        router.start()
+        reqs, kinds = [], []
+        t0 = time.monotonic()
+        deadline = t0 + 300.0
+        issued_shorts = issued_bulk = 0
+        try:
+            while True:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(f"tiering leg {tag} wedged")
+                now = time.monotonic() - t0
+                # Bulk backlog lands up front; interactive shorts pace
+                # through the window (deadline_ms classifies them —
+                # generous enough that none can expire: the zero-drop
+                # gate stays meaningful).
+                while issued_bulk < n_bulk:
+                    sp = SamplingParams(max_tokens=bulk_toks)
+                    reqs.append(router.enqueue_request(
+                        f"bulk{issued_bulk % 4}", "", "test-tiny",
+                        prompt_tokens=[1] * 8, sampling=sp))
+                    kinds.append("bulk")
+                    issued_bulk += 1
+                want = min(n_short, int(now / window_s * n_short) + 1)
+                while issued_shorts < want:
+                    sp = SamplingParams(max_tokens=short_toks)
+                    sp.deadline_ms = 60_000.0
+                    reqs.append(router.enqueue_request(
+                        f"int{issued_shorts % 8}", "", "test-tiny",
+                        prompt_tokens=[1] * 4, sampling=sp))
+                    kinds.append("interactive")
+                    issued_shorts += 1
+                for r in reqs:
+                    r.stream.drain()
+                done = sum(1 for r in reqs if r.stats.finished_at)
+                touch("tiering")
+                if issued_shorts >= n_short and done >= len(reqs):
+                    break
+                time.sleep(0.005)
+            elapsed = time.monotonic() - t0
+            tokens = sum(r.stats.completion_tokens for r in reqs)
+            dropped = sum(1 for r in reqs if not r.stats.finished_at)
+
+            def pctl(xs, q):
+                xs = sorted(xs)
+                return (round(xs[min(len(xs) - 1, int(q * len(xs)))], 1)
+                        if xs else None)
+
+            out = {"tok_per_s": round(tokens / max(1e-9, elapsed), 1),
+                   "elapsed_s": round(elapsed, 3),
+                   "tokens": tokens, "dropped_streams": dropped}
+            for cls in ("interactive", "bulk"):
+                ttfts = [r.stats.ttft_ms for r, k in zip(reqs, kinds)
+                         if k == cls and r.stats.first_token_at]
+                out[f"{cls}_ttft_p50_ms"] = pctl(ttfts, 0.5)
+                out[f"{cls}_ttft_p99_ms"] = pctl(ttfts, 0.99)
+            # Counter, not a ring scan: the admission churn of a parked
+            # bulk backlog can rotate early records out of the ring (the
+            # spill files below keep everything for the audit).
+            out["overflows"] = (router.tiers.overflow_count
+                                if router.tiers is not None else 0)
+            out["invariant_violations"] = len(
+                check_invariants(router.journal.tail(None)))
+            return out, spills
+        finally:
+            router.stop()
+
+    tiered, tiered_spills = run_leg(
+        "tiered", [lat_grade, thr_grade], "interactive=r0;bulk=r1")
+    homo_lat, lat_spills = run_leg("homo-lat", [lat_grade, lat_grade],
+                                   None)
+    homo_thr, _ = run_leg("homo-thr", [thr_grade, thr_grade], None)
+
+    # Multi-spill audit: the tiered leg's router + member journals
+    # checked as ONE run (invariants, zero-drop, regroup pairing).
+    audit_bad, audit_records = check_files(
+        [p for p in tiered_spills if os.path.exists(p)])
+
+    # Regroup exercise: a 3-member tiered mini-fleet under a class-mix
+    # shift — the balancer must retier a bulk member into interactive
+    # (drain -> migrate live streams -> rejoin), journaled start->done.
+    regroup = {"regroups_done": 0, "regroups_aborted": 0}
+    ecfg = EngineConfig(max_slots=4, **base_kw)
+    members = [LocalMember(f"r{i}",
+                           FakeEngine(dataclasses.replace(ecfg),
+                                      blocklist_path=None,
+                                      token_latency_s=0.02))
+               for i in range(3)]
+    router = FleetRouter(
+        members, ecfg, blocklist_path=None, probe_period_s=0.05,
+        eject_heartbeat_s=5.0, reprobe_backoff_s=0.2, evac_grace_s=1.0,
+        tiers="interactive=r0;bulk=r1,r2",
+        tiering_kw=dict(ema_alpha=0.3, deadband=0.1, cooldown_s=0.2,
+                        min_samples=8))
+    router.start()
+    try:
+        deadline = time.monotonic() + 60.0
+        i = 0
+        while time.monotonic() < deadline:
+            sp = SamplingParams(max_tokens=4)
+            sp.deadline_ms = 60_000.0  # all-interactive mix shift
+            req = router.enqueue_request(f"mix{i % 4}", "", "test-tiny",
+                                         prompt_tokens=[1] * 4,
+                                         sampling=sp)
+            i += 1
+            t1 = time.monotonic() + 5.0
+            while not req.stats.finished_at and time.monotonic() < t1:
+                req.stream.drain()
+                time.sleep(0.005)
+            touch("tiering")
+            recs = router.journal.tail(None, kind="tier_regroup")
+            regroup["regroups_done"] = sum(
+                1 for r in recs if r.get("phase") == "done")
+            regroup["regroups_aborted"] = sum(
+                1 for r in recs if r.get("phase") == "aborted")
+            if regroup["regroups_done"] >= 1:
+                break
+        regroup["interactive_members"] = len(
+            router.tiers._tier_members("interactive"))
+        regroup["mix_ema"] = (round(router.tiers.mix_ema, 3)
+                              if router.tiers.mix_ema is not None
+                              else None)
+    finally:
+        router.stop()
+
+    gate = bool(
+        tiered["interactive_ttft_p99_ms"] is not None
+        and homo_lat["interactive_ttft_p99_ms"] is not None
+        and tiered["interactive_ttft_p99_ms"]
+        <= homo_lat["interactive_ttft_p99_ms"]
+        and tiered["tok_per_s"] >= homo_lat["tok_per_s"]
+        and tiered["dropped_streams"] == 0
+        and tiered["invariant_violations"] == 0
+        and regroup["regroups_done"] >= 1
+        and not audit_bad)
+    return {
+        "interactive_requests": n_short,
+        "bulk_requests": n_bulk,
+        "tiered": tiered,
+        "homogeneous_latency_grade": homo_lat,
+        "homogeneous_throughput_grade": homo_thr,
+        "regroup_exercise": regroup,
+        "journal_audit_records": audit_records,
+        "journal_audit_violations": len(audit_bad),
+        "pass": gate,
     }
 
 

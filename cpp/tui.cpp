@@ -407,6 +407,26 @@ struct Tui {
                     fh, fe, fd);
       out.push_back(std::string(fe > 0 ? RED : CYAN) + l + RST);
     }
+    /* Tiers line (tiered fleets only): healthy/total per replica tier.
+     * RED when any tier has ZERO healthy members — that tier's traffic
+     * is being served cross-tier (journaled overflow) until a member
+     * heals or regroups in. */
+    auto tiers = stats->get("tiers");
+    if (tiers && tiers->type == mj::Value::OBJ) {
+      std::string line = " tiers";
+      bool starved = false;
+      for (auto &kv : tiers->obj) {
+        auto &t = kv.second;
+        if (!t || t->type != mj::Value::OBJ) continue;
+        double th = t->get("healthy") ? t->get("healthy")->as_num() : 0;
+        double tt = t->get("total") ? t->get("total")->as_num() : 0;
+        if (tt > 0 && th <= 0) starved = true;
+        std::snprintf(l, sizeof l, "  %s %.0f/%.0f", kv.first.c_str(), th,
+                      tt);
+        line += l;
+      }
+      out.push_back(std::string(starved ? RED : CYAN) + line + RST);
+    }
     /* One row PER chip (pod-wide under SPMD): the north star's "per-chip
      * HBM occupancy" — a v5e-16 must not show chip 0 for the pod. */
     auto chips = stats->get("chips");

@@ -24,6 +24,7 @@ set -- --no-tui --host 0.0.0.0
 [ -n "${DRAIN_TIMEOUT_S:-}" ] && set -- "$@" --drain-timeout-s "$DRAIN_TIMEOUT_S"
 [ "${MIGRATE:-}" = "false" ] && set -- "$@" --no-migrate
 [ -n "${MIGRATE_TIMEOUT_S:-}" ] && set -- "$@" --migrate-timeout-s "$MIGRATE_TIMEOUT_S"
+[ -n "${TIERS:-}" ] && set -- "$@" --tiers "$TIERS"
 [ -n "${MAX_SLOTS:-}" ] && set -- "$@" --max-slots "$MAX_SLOTS"
 [ -n "${WAL_DIR:-}" ] && set -- "$@" --wal-dir "$WAL_DIR"
 [ -n "${WAL_FSYNC_MS:-}" ] && set -- "$@" --wal-fsync-ms "$WAL_FSYNC_MS"

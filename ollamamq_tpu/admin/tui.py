@@ -114,6 +114,15 @@ def _engine_stats_brief(engine) -> dict:
             out["replicas"] = fleet()
         except Exception:
             pass
+    # Tiers line (tiered fleets only): healthy/total per tier — the C++
+    # side renders it red when any tier has ZERO healthy members (that
+    # tier's traffic is running cross-tier until a member heals in).
+    tiers = getattr(engine, "tiers", None)
+    if tiers is not None:
+        try:
+            out["tiers"] = tiers.counts()
+        except Exception:
+            pass
     return out
 
 

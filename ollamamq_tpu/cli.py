@@ -168,6 +168,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="per-transfer migration budget: a transfer "
                         "(export + ship + import ack) past this aborts "
                         "and the stream falls back to recompute replay")
+    p.add_argument("--tiers", default=os.environ.get("TIERS", ""),
+                   help="SLO-aware replica tiers for the fleet router "
+                        "(needs --replicas/--replica-urls): "
+                        "'interactive=r0;bulk=r1,r2' maps members to "
+                        "tiers by name (or tpN for every member at that "
+                        "TP width; an @tpN suffix on the tier declares "
+                        "the width a retiered member restarts at). "
+                        "VIP/boost users and deadlined requests place "
+                        "on the interactive tier, everything else on "
+                        "bulk; cross-tier placement only under "
+                        "journaled SLO burn-rate overflow or an empty "
+                        "tier, and a TierBalancer retiers members "
+                        "(drain -> migrate -> restart -> rejoin) as the "
+                        "class mix shifts. Unknown tier names or a tier "
+                        "with no members fail startup")
     # Graceful degradation under load.
     p.add_argument("--max-queued", type=int, default=0,
                    help="global queued-request cap: past it, enqueues are "
@@ -461,6 +476,22 @@ def main(argv=None) -> int:
     if args.migrate_timeout_s <= 0:
         log.error("--migrate-timeout-s must be > 0")
         return 2
+    if args.tiers:
+        # Tier spec fails fast BEFORE any device work: unknown tier
+        # names, selectors naming no member, and a tier with no members
+        # all kill the process at startup, not at the first placement.
+        if args.replicas <= 1 and not fleet_urls:
+            log.error("--tiers needs a fleet "
+                      "(--replicas > 1 and/or --replica-urls)")
+            return 2
+        from ollamamq_tpu.config import validate_tiers
+
+        roster = ([(f"r{i}", args.tp) for i in range(args.replicas)]
+                  + [(f"h{j}", None) for j in range(len(fleet_urls))])
+        tiers_err = validate_tiers(args.tiers, roster)
+        if tiers_err is not None:
+            log.error("invalid --tiers: %s", tiers_err)
+            return 2
     # Quantization flags fail fast BEFORE any device/runtime work: an
     # unsupported combination must kill the process at startup, not at
     # the first dispatch (same validator the SPMD worker and the
@@ -571,6 +602,7 @@ def main(argv=None) -> int:
         drain_timeout_s=args.drain_timeout_s,
         migrate=not args.no_migrate,
         migrate_timeout_s=args.migrate_timeout_s,
+        tiers=args.tiers or None,
     )
     fairness = Fairness.TOKENS if args.token_fairness else Fairness.REQUESTS
 
@@ -595,21 +627,45 @@ def main(argv=None) -> int:
         # and double-recover every stream).
         member_cfg = dataclasses.replace(
             ecfg, max_queued=0, max_queued_per_user=0, journal_file=None,
-            wal_dir=None)
-        members = []
-        for i in range(args.replicas):
-            if args.fake_engine:
-                from ollamamq_tpu.engine.fake import FakeEngine
+            wal_dir=None, tiers=None)
+        # Tiered fleets: members assigned to a tier that declares an
+        # @tpN width START at that width; the same factory rebuilds a
+        # member at a new width when the TierBalancer regroups it.
+        tier_assign, tier_widths = {}, {}
+        if args.tiers:
+            from ollamamq_tpu.config import assign_tiers
 
-                eng = FakeEngine(member_cfg, models=models,
-                                 blocklist_path=None, fairness=fairness,
-                                 token_latency_s=_fake_latency())
-            else:
+            roster = ([(f"r{i}", args.tp) for i in range(args.replicas)]
+                      + [(f"h{j}", None)
+                         for j in range(len(fleet_urls))])
+            tier_assign, tier_widths = assign_tiers(args.tiers, roster)
+
+        def _member_factory(base_cfg):
+            def build(tp=None):
+                cfg = (base_cfg if tp in (None, base_cfg.tp)
+                       else dataclasses.replace(base_cfg, tp=tp))
+                if args.fake_engine:
+                    from ollamamq_tpu.engine.fake import FakeEngine
+
+                    return FakeEngine(cfg, models=models,
+                                      blocklist_path=None,
+                                      fairness=fairness,
+                                      token_latency_s=_fake_latency())
                 from ollamamq_tpu.engine.engine import TPUEngine
 
-                eng = TPUEngine(member_cfg, models=models,
-                                blocklist_path=None, fairness=fairness)
-            members.append(LocalMember(f"r{i}", eng))
+                return TPUEngine(cfg, models=models, blocklist_path=None,
+                                 fairness=fairness)
+            return build
+
+        members = []
+        for i in range(args.replicas):
+            name = f"r{i}"
+            width = tier_widths.get(tier_assign.get(name))
+            cfg_i = (member_cfg if width in (None, member_cfg.tp)
+                     else dataclasses.replace(member_cfg, tp=width))
+            factory = _member_factory(cfg_i)
+            members.append(LocalMember(name, factory(),
+                                       engine_factory=factory))
         for j, url in enumerate(fleet_urls):
             members.append(HttpMember(f"h{j}", url,
                                       timeout_s=args.timeout))

@@ -48,6 +48,13 @@ REQUEST_STALL_S = 120.0
 # (alert "preempt_storm", resolves when the rate drops).
 PREEMPT_STORM_PER_MIN = 30.0
 PREEMPT_STORM_WINDOW_S = 60.0
+# Regroup-storm rule (tiered fleets): each tier regroup costs a drain +
+# stream migrations + an engine restart — a healthy balancer regroups
+# occasionally as the class mix shifts; this many per minute means the
+# hysteresis is mis-tuned (or the mix is adversarial) and the fleet is
+# burning capacity on churn (alert "regroup_storm", resolves when the
+# rate drops).
+REGROUP_STORM_PER_MIN = 4.0
 
 
 class HealthMonitor:
@@ -246,6 +253,7 @@ class HealthMonitor:
             "reduced until they heal)", "replica")
 
         self._check_preempt_storm()
+        self._check_regroup_storm()
         self._check_journal_invariants()
 
         slo = getattr(self.engine, "slo", None)
@@ -293,6 +301,30 @@ class HealthMonitor:
                 "recompute is eating throughput)", source="watchdog")
         else:
             alerts.resolve("preempt_storm")
+
+    def _check_regroup_storm(self) -> None:
+        """AlertManager rule for tier-regroup storms (tiered fleets
+        only: the engine exposes a TierManager at `.tiers`). Like the
+        preemption storm, this is degradation pressure rather than a
+        watchdog stall, so it bypasses _alert and its stall counter."""
+        alerts = getattr(self.engine, "alerts", None)
+        tiers = getattr(self.engine, "tiers", None)
+        if alerts is None or tiers is None:
+            return
+        try:
+            rate = tiers.regroup_rate_per_min()
+        except Exception:  # noqa: BLE001
+            log.exception("regroup-rate read failed")
+            return
+        if rate > REGROUP_STORM_PER_MIN:
+            alerts.fire(
+                "regroup_storm", "warn",
+                f"tier regroup storm: {rate:.0f} regroups/min — the "
+                "balancer is flapping members between tiers (hysteresis "
+                "mis-tuned for this class mix); each regroup costs a "
+                "drain + migrations + a restart", source="watchdog")
+        else:
+            alerts.resolve("regroup_storm")
 
     def _check_journal_invariants(self) -> None:
         """Flight-recorder invariant sweep over the decision-journal ring

@@ -1,4 +1,5 @@
 from ollamamq_tpu.fleet.members import HttpMember, LocalMember
 from ollamamq_tpu.fleet.router import FleetRouter
+from ollamamq_tpu.fleet.tiering import TierManager
 
-__all__ = ["FleetRouter", "LocalMember", "HttpMember"]
+__all__ = ["FleetRouter", "LocalMember", "HttpMember", "TierManager"]

@@ -134,6 +134,13 @@ class _MemberBase:
         self.drain_started_at = 0.0
         self.drain_deadline = 0.0
         self.forced_stale_until = 0.0  # fault site "replica", kind "slow"
+        # Tiered fleet (fleet/tiering.py): which replica tier this
+        # member serves (None = untiered fleet), and — while a regroup's
+        # drain is in flight — the tier it is moving to. The tier
+        # commits only when the retier restart succeeds; an abort
+        # (crash mid-retier, restart failure) leaves the ORIGINAL tier.
+        self.tier: Optional[str] = None
+        self.retier_to: Optional[str] = None
 
     def force_stale(self, delay_s: float) -> None:
         self.forced_stale_until = time.monotonic() + float(delay_s)
@@ -146,9 +153,22 @@ class LocalMember(_MemberBase):
     kind_label = "local"
     router_bounded = False  # the engine's own capacity gate bounds intake
 
-    def __init__(self, name: str, engine) -> None:
+    def __init__(self, name: str, engine, engine_factory=None) -> None:
         super().__init__(name)
         self.engine = engine
+        # Tier regrouping: `engine_factory(tp)` builds a replacement
+        # engine at a different TP width (same models/fairness — the
+        # CLI closes over its construction args). Without one, a retier
+        # that declares a width change falls back to a re-label +
+        # same-width hot restart.
+        self.engine_factory = engine_factory
+
+    @property
+    def tp(self) -> Optional[int]:
+        return getattr(self.engine.ecfg, "tp", None)
+
+    def slot_cap(self) -> int:
+        return int(getattr(self.engine.ecfg, "max_slots", 0))
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -192,6 +212,28 @@ class LocalMember(_MemberBase):
         start — the rolling-restart primitive."""
         self.engine.stop()
         self.engine.start()
+
+    def retier(self, tp: Optional[int] = None) -> Optional[int]:
+        """Drain-complete retier restart: rebuild the engine at the
+        target tier's TP width (the drain already emptied it — weights
+        reload, KV pool reallocates at the new sharding). No factory or
+        no width change => a plain hot restart (re-label only). Returns
+        the width the member now runs at. On a failed rebuild the OLD
+        engine restarts and the error propagates — the caller aborts
+        the regroup and the member keeps its original tier."""
+        if tp is None or tp == self.tp or self.engine_factory is None:
+            self.hot_restart()
+            return self.tp
+        old = self.engine
+        old.stop()
+        try:
+            fresh = self.engine_factory(tp)
+        except Exception:
+            old.start()  # the member must not stay dead over a bad width
+            raise
+        self.engine = fresh
+        fresh.start()
+        return self.tp
 
     # -- health ------------------------------------------------------------
     def alive(self) -> bool:
@@ -376,6 +418,19 @@ class HttpMember(_MemberBase):
         # The remote process restarts itself (rolling deploy); drain's
         # job here was only to quiesce placements first.
         self._forced_down = False
+
+    @property
+    def tp(self) -> Optional[int]:
+        return None  # no TP introspection over HTTP
+
+    def slot_cap(self) -> int:
+        return 0  # the router's own bound applies (router_bounded)
+
+    def retier(self, tp: Optional[int] = None) -> Optional[int]:
+        # Re-label only: the remote service owns its own TP width (a
+        # rolling redeploy at the new width is the operator's move).
+        self.hot_restart()
+        return None
 
     def _poll_loop(self) -> None:
         while not self._stop.wait(self.poll_period_s):

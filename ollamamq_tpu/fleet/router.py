@@ -80,7 +80,7 @@ class _Flight:
                  "raw_prompt", "prompt_tokens", "sampling", "member",
                  "attempt", "resume", "failed_from", "evac_since",
                  "evac_deadline", "begin_failures", "done",
-                 "migrate_tried")
+                 "migrate_tried", "tier", "cls")
 
     def __init__(self, req: Request, ip: str, family) -> None:
         self.req = req
@@ -102,6 +102,11 @@ class _Flight:
         self.begin_failures = 0
         self.done = False
         self.migrate_tried = False  # one migration attempt per drain
+        # Tiered fleet: the request's class (vip/boost/deadline/default)
+        # and home tier, set at first placement and carried through
+        # failover/migration so evacuated streams land back IN-TIER.
+        self.tier: Optional[str] = None
+        self.cls: Optional[str] = None
 
 
 class FleetRouter:
@@ -117,7 +122,9 @@ class FleetRouter:
                  reprobe_backoff_s: float = REPROBE_BACKOFF_S,
                  evac_grace_s: float = EVAC_GRACE_S,
                  migrate: Optional[bool] = None,
-                 migrate_timeout_s: Optional[float] = None):
+                 migrate_timeout_s: Optional[float] = None,
+                 tiers: Optional[str] = None,
+                 tiering_kw: Optional[dict] = None):
         assert members, "a fleet needs at least one member"
         if placement not in ("affinity", "least_loaded"):
             raise ValueError(f"unknown placement policy {placement!r} "
@@ -158,14 +165,19 @@ class FleetRouter:
         # runtimes record into its own SLOEngine) to avoid double-counting
         # the global ollamamq_slo_* series.
         self.slo = SLOEngine(self.alerts)
+        tiers_spec = (getattr(engine_cfg, "tiers", None)
+                      if tiers is None else tiers)
+        meta = {"fleet": len(self.members), "placement": placement,
+                "model": engine_cfg.model}
+        if tiers_spec:
+            meta["tiers"] = tiers_spec
         self.journal = Journal(
             capacity=engine_cfg.journal_ring,
             path=engine_cfg.journal_file,
             rotate_bytes=int(engine_cfg.journal_rotate_mb * 1e6),
             keep=engine_cfg.journal_keep,
             sample=getattr(engine_cfg, "journal_sample", 1.0),
-            meta={"fleet": len(self.members), "placement": placement,
-                  "model": engine_cfg.model})
+            meta=meta)
         self.health = None
         self.shed_counts: Dict[str, int] = {}
         self.failover_count = 0
@@ -195,6 +207,17 @@ class FleetRouter:
             self.durability = DurabilityManager(
                 engine_cfg, journal=self.journal, alerts=self.alerts,
                 fault_plan=self.fault_plan)
+        # Tiered fleet (fleet/tiering.py): class-aware placement, per-
+        # tier SLO burn overflow, and the adaptive-regrouping balancer.
+        # None = untiered (every member interchangeable, as before).
+        self.tiers = None
+        if tiers_spec:
+            from ollamamq_tpu.fleet.tiering import TierManager
+
+            self.tiers = TierManager(self.members, tiers_spec,
+                                     core=self.core, journal=self.journal,
+                                     ecfg=engine_cfg,
+                                     **(tiering_kw or {}))
         for mem in self.members:
             self.journal.record("replica_join", replica=mem.name,
                                 why="start")
@@ -486,6 +509,10 @@ class FleetRouter:
         self.last_tick_at = time.monotonic()
         self.journal.tick += 1
         self._probe()
+        if self.tiers is not None:
+            # Balancer tick: retier ONE member toward the observed class
+            # mix once the hysteresis clears (no-op most ticks).
+            self.tiers.maybe_balance(self)
         # Drain BEFORE admission: a draining member's migrating streams
         # get first claim on slots other members just freed — fresh
         # placements must not starve the evacuation that unblocks the
@@ -521,9 +548,19 @@ class FleetRouter:
                 emb_ok.append(model)
         return gen_ok, emb_ok
 
+    def _slot_cap(self, mem) -> int:
+        cap = mem.slot_cap() if hasattr(mem, "slot_cap") else 0
+        return cap or self.ecfg.max_slots
+
     def _choose_member(self, flight: _Flight):
         elig = [m for m in self.members
                 if self._can_place(m, flight.model, flight.kind)]
+        tinfo = None
+        if self.tiers is not None and flight.kind == "generate" and elig:
+            # Tier filter FIRST: affinity and least-loaded then operate
+            # WITHIN the home tier (plus any journaled overflow targets).
+            elig, tinfo = self.tiers.placement_filter(
+                flight, elig, self._load_of, self._slot_cap)
         if not elig:
             return None
         # Never fail BACK to the member that just dropped this stream —
@@ -543,16 +580,21 @@ class FleetRouter:
         # reference's last_backend_idx round-robin).
         best_load = min(self._load_of(m) for m in elig)
         ties = [m for m in elig if self._load_of(m) == best_load]
+        cand = ties[0]
         n = len(self.members)
         for off in range(1, n + 1):
-            cand = self.members[(self._rr + off) % n]
-            if cand in ties:
+            c = self.members[(self._rr + off) % n]
+            if c in ties:
                 self._rr = (self._rr + off) % n
-                return cand
-        return ties[0]
+                cand = c
+                break
+        if tinfo is not None:
+            self.tiers.journal_place(flight, cand, tinfo)
+        return cand
 
     def _admit(self) -> int:
         placed = 0
+        unplaceable: set = set()  # flights requeued THIS pass (by id)
         while True:
             gen_ok, emb_ok = self._eligible_models()
             if not gen_ok and not emb_ok:
@@ -589,10 +631,17 @@ class FleetRouter:
                 continue
             mem = self._choose_member(flight)
             if mem is None:
-                # Capacity raced away between the gate and the pick:
-                # wait-in-queue, FIFO preserved.
+                # Capacity raced away between the gate and the pick — or
+                # the flight's home TIER is full (tier isolation: it
+                # waits rather than leaking cross-tier). Wait-in-queue,
+                # FIFO preserved; keep admitting OTHER users this pass
+                # (a full bulk tier must not park the interactive queue
+                # behind it), breaking once the same flight cycles back.
                 self._requeue(flight, why="unplaceable")
-                break
+                if id(flight) in unplaceable:
+                    break
+                unplaceable.add(id(flight))
+                continue
             self._maybe_ship_prefix(flight, mem)
             if self._dispatch(flight, mem):
                 placed += 1
@@ -707,6 +756,12 @@ class FleetRouter:
             flight.req.stats.first_token_at = time.monotonic()
             flight.req.trace_event(
                 "first_token", ttft_ms=round(flight.req.stats.ttft_ms, 3))
+            if self.tiers is not None and flight.tier is not None:
+                # Feed the per-tier burn-rate engine: TTFT is recorded
+                # against the stream's HOME tier — the tier whose SLO
+                # the placement policy is protecting.
+                self.tiers.record_ttft(flight.tier,
+                                       flight.req.stats.ttft_ms)
         # Empty-text items still forward: they carry the sampled token
         # ids the NDJSON writer folds into the next written frame.
         flight.req.stream.push(item)
@@ -758,13 +813,22 @@ class FleetRouter:
     # ------------------------------------------------------------- migration
     def _choose_migration_target(self, flight: _Flight, source):
         """Healthy member to receive a shipped stream: least-loaded
-        among those that can take the model and speak import."""
+        among those that can take the model and speak import. Tiered
+        fleets prefer the victim's HOME tier — an evacuated stream
+        lands back in-tier, not just least-loaded fleet-wide — and
+        only fall cross-tier (journaled by the caller) when the tier
+        has no import-capable capacity."""
         elig = [m for m in self.members
                 if m is not source
                 and getattr(m, "import_stream", None) is not None
                 and self._can_place(m, flight.model, "generate")]
         if not elig:
             return None
+        if self.tiers is not None and flight.tier is not None:
+            same = [m for m in elig
+                    if getattr(m, "tier", None) == flight.tier]
+            if same:
+                elig = same
         return min(elig, key=self._load_of)
 
     def _try_migrate(self, flight: _Flight, source, why: str) -> str:
@@ -891,6 +955,10 @@ class FleetRouter:
         self.migration_count += 1
         tm.FLEET_MIGRATIONS_TOTAL.labels(outcome="migrated").inc()
         tm.FLEET_MIGRATE_BYTES_TOTAL.inc(nbytes)
+        if self.tiers is not None:
+            # A migration that had to land cross-tier (home tier full)
+            # is still an overflow — journaled, never silent.
+            self.tiers.journal_failover_overflow(flight, target)
         self.journal.record(
             "migrate_import", req_id=flight.rid0, user=flight.user,
             model=flight.model or None, replica=source.name,
@@ -1062,6 +1130,12 @@ class FleetRouter:
         mem.eject_count += 1
         mem.backoff_s = self.reprobe_backoff_s
         mem.next_probe_at = time.monotonic() + mem.backoff_s
+        if mem.retier_to is not None:
+            # A crash mid-retier aborts the regroup: the member keeps
+            # (and later rejoins) its ORIGINAL tier; its streams ride
+            # the normal eject ladder below (migrate -> recompute ->
+            # never drop).
+            self._abort_retier(mem, f"eject:{why}")
         self.journal.record(
             "replica_eject", replica=mem.name, why=why,
             victims=len(victims),
@@ -1129,6 +1203,8 @@ class FleetRouter:
             counts[mem.state] = counts.get(mem.state, 0) + 1
         for state, n in counts.items():
             tm.FLEET_REPLICAS.labels(state=state).set(n)
+        if self.tiers is not None:
+            self.tiers.update_gauges()
 
     # ---------------------------------------------------------------- drain
     def _member(self, name: str):
@@ -1152,21 +1228,145 @@ class FleetRouter:
                 "replicas (it will rejoin via the health re-probe)")
         inflight = self._load_of(mem)
         if mem.state != "draining":
-            now = time.monotonic()
-            mem.state = "draining"
-            mem.drain_started_at = now
-            mem.drain_deadline = now + (timeout_s if timeout_s is not None
-                                        else self.drain_timeout_s)
-            self.journal.record(
-                "replica_drain", replica=mem.name, inflight=inflight,
-                timeout_s=round(mem.drain_deadline - now, 1))
-            log.warning("replica %s draining: %d in-flight stream(s) "
-                        "running to completion, no new placements",
-                        mem.name, inflight)
-            self._update_gauges()
-            self.notify()
+            self._start_drain(mem, timeout_s)
         return {"replica": mem.name, "state": mem.state,
                 "inflight": inflight}
+
+    def _start_drain(self, mem, timeout_s: Optional[float]) -> None:
+        now = time.monotonic()
+        inflight = self._load_of(mem)
+        mem.state = "draining"
+        mem.drain_started_at = now
+        mem.drain_deadline = now + (timeout_s if timeout_s is not None
+                                    else self.drain_timeout_s)
+        self.journal.record(
+            "replica_drain", replica=mem.name, inflight=inflight,
+            timeout_s=round(mem.drain_deadline - now, 1))
+        log.warning("replica %s draining: %d in-flight stream(s) "
+                    "running to completion, no new placements",
+                    mem.name, inflight)
+        self._update_gauges()
+        self.notify()
+
+    # ----------------------------------------------------------- regrouping
+    def retier_replica(self, name: str, tier: str,
+                       timeout_s: Optional[float] = None,
+                       why: str = "manual") -> dict:
+        """Move one member to the other tier: drain (PR 9), migrate its
+        live streams off (PR 11), hot-restart at the target tier's TP
+        width (LocalMember with a factory) or re-label (HttpMember),
+        rejoin. Callable from any thread (HTTP admin) and from the
+        TierBalancer. The tier label commits only when the restart
+        succeeds — any abort leaves the member in its ORIGINAL tier."""
+        from ollamamq_tpu.config import TIER_NAMES
+
+        if self.tiers is None:
+            raise RuntimeError("fleet is untiered (--tiers not set); "
+                               "retier applies to tiered fleets")
+        mem = self._member(name)
+        if mem is None:
+            raise KeyError(f"no replica named {name!r} "
+                           f"(members: {[m.name for m in self.members]})")
+        if tier not in TIER_NAMES:
+            raise ValueError(f"unknown tier {tier!r} "
+                             f"(tiers: {TIER_NAMES})")
+        if mem.tier == tier:
+            raise RuntimeError(f"replica {name} is already in tier "
+                               f"{tier!r}")
+        if mem.state == "ejected":
+            raise RuntimeError(
+                f"replica {name} is ejected; it must heal before it can "
+                "change tiers")
+        if mem.retier_to is not None or any(
+                m.retier_to is not None for m in self.members):
+            raise RuntimeError("a tier regroup is already in flight; "
+                               "one member moves at a time")
+        donors = [m for m in self.members
+                  if getattr(m, "tier", None) == mem.tier
+                  and m.state != "ejected"]
+        if len(donors) <= 1:
+            raise RuntimeError(
+                f"replica {name} is tier {mem.tier!r}'s last serving "
+                "member; a regroup must never empty a tier")
+        self.journal.record(
+            "tier_regroup", replica=mem.name, phase="start",
+            from_tier=mem.tier, to_tier=tier, why=why,
+            mix=(round(self.tiers.mix_ema, 4)
+                 if self.tiers.mix_ema is not None else None),
+            tp_from=getattr(mem, "tp", None),
+            tp_to=self.tiers.widths.get(tier))
+        log.warning("replica %s regrouping %s -> %s (%s): draining, "
+                    "live streams migrate off, restart at the target "
+                    "width", mem.name, mem.tier, tier, why)
+        mem.retier_to = tier
+        if mem.state != "draining":
+            self._start_drain(mem, timeout_s)
+        return {"replica": mem.name, "state": mem.state,
+                "from_tier": mem.tier, "to_tier": tier}
+
+    def _abort_retier(self, mem, why: str) -> None:
+        """A regroup died before its restart committed: journal the
+        abort; the member keeps its ORIGINAL tier (and rejoins it when
+        it heals)."""
+        target = mem.retier_to
+        mem.retier_to = None
+        self.journal.record(
+            "tier_regroup", replica=mem.name, phase="aborted",
+            from_tier=mem.tier, to_tier=target, why=why)
+        self.tiers.note_regroup("aborted")
+        log.error("replica %s regroup %s -> %s ABORTED (%s); member "
+                  "keeps tier %s", mem.name, mem.tier, target, why,
+                  mem.tier)
+
+    def _complete_retier(self, mem) -> None:
+        """Drain emptied under a pending retier: restart the member at
+        the target tier's width and commit the label. The "replica"
+        fault site is drawn here too — chaos can crash the member
+        mid-retier, which aborts the regroup (original tier) and rides
+        the normal eject/heal path; its streams already migrated off
+        during the drain, so nothing can drop."""
+        target = mem.retier_to
+        if self.fault_plan is not None:
+            try:
+                fired = self.fault_plan.draw("replica")
+            except Exception:  # noqa: BLE001
+                log.exception("fault-plan draw failed")
+                fired = []
+            for kind, rule in fired:
+                if kind == "device_loss" and rule is not None:
+                    self._plan_down.add(mem.name)
+                if kind in ("exception", "device_loss"):
+                    mem.crash()
+                    self._eject(mem, "crash_mid_retier",
+                                mem.heartbeat_age())
+                    return  # _eject aborted the regroup
+                if kind == "slow" and rule is not None:
+                    mem.force_stale(rule.delay_s)
+        try:
+            tp = mem.retier(self.tiers.widths.get(target))
+        except Exception:  # noqa: BLE001 — old-width engine restarted
+            log.exception("retier restart of %s at tier %s width failed",
+                          mem.name, target)
+            self._abort_retier(mem, "restart_failed")
+            mem.state = "healthy" if mem.alive() else mem.state
+            self._update_gauges()
+            return
+        from_tier = mem.tier
+        mem.tier = target
+        mem.retier_to = None
+        mem.state = "healthy"
+        self.journal.record(
+            "tier_regroup", replica=mem.name, phase="done",
+            from_tier=from_tier, to_tier=target,
+            mix=(round(self.tiers.mix_ema, 4)
+                 if self.tiers.mix_ema is not None else None),
+            tp_to=tp)
+        self.journal.record("replica_join", replica=mem.name,
+                            why="retier")
+        self.tiers.note_regroup("done")
+        log.warning("replica %s regrouped -> tier %s (tp %s); back in "
+                    "rotation", mem.name, target, tp)
+        self._update_gauges()
 
     def _drain_progress(self) -> None:
         now = time.monotonic()
@@ -1194,6 +1394,11 @@ class FleetRouter:
             active = [f for f in self.flights
                       if f.member is mem and not f.done]
             if not active:
+                if mem.retier_to is not None:
+                    # Regroup drain emptied: restart at the target
+                    # tier's width and commit (or abort) the move.
+                    self._complete_retier(mem)
+                    continue
                 try:
                     mem.hot_restart()
                 except Exception:  # noqa: BLE001
@@ -1223,7 +1428,7 @@ class FleetRouter:
         rows = []
         for mem in self.members:
             age = mem.heartbeat_age()
-            rows.append({
+            row = {
                 "name": mem.name,
                 "kind": mem.kind_label,
                 "state": mem.state,
@@ -1232,7 +1437,10 @@ class FleetRouter:
                 "inflight": self._load_of(mem),
                 "ejects": mem.eject_count,
                 "alerts": [n for n, _ in mem.active_alerts()],
-            })
+            }
+            if mem.tier is not None:
+                row["tier"] = mem.tier
+            rows.append(row)
         return {
             "placement": self.placement,
             "drain_timeout_s": self.drain_timeout_s,
@@ -1244,6 +1452,8 @@ class FleetRouter:
             "migrations": self.migration_count,
             "migrate_aborts": self.migrate_abort_count,
             "queued": self.core.total_queued(),
+            "tiers": (self.tiers.status() if self.tiers is not None
+                      else None),
         }
 
     def scheduler_stats(self) -> dict:

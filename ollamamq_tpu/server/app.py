@@ -148,6 +148,10 @@ class Server:
         if hasattr(self.engine, "drain_replica"):
             r.add_route("GET", "/admin/fleet", self.admin_fleet)
             r.add_route("POST", "/admin/drain/{replica}", self.admin_drain)
+            # Tiered fleet (--tiers): per-tier status + manual regroup.
+            r.add_route("GET", "/admin/tiers", self.admin_tiers)
+            r.add_route("POST", "/admin/retier/{replica}",
+                        self.admin_retier)
         # KV migration wire (only when the engine IS an engine, not a
         # router): the fleet's HttpMember speaks these to ship a live
         # stream's pages + request state between member services.
@@ -627,6 +631,50 @@ class Server:
             out = self.engine.drain_replica(name, timeout_s=timeout_s)
         except KeyError as e:
             raise ApiError(404, str(e.args[0]) if e.args else str(e))
+        except RuntimeError as e:
+            raise ApiError(409, str(e))
+        return web.json_response({"status": "success", **out})
+
+    async def admin_tiers(self, request: web.Request) -> web.Response:
+        """Tiered-fleet status: per-tier membership and states, TTFT
+        burn rates and overflow state, the balancer's class-mix EMA,
+        and overflow/regroup counters. 404 on an untiered fleet."""
+        self._ident(request)
+        tiers = getattr(self.engine, "tiers", None)
+        if tiers is None:
+            raise ApiError(404, "fleet is untiered (--tiers not set)")
+        return web.json_response(tiers.status())
+
+    async def admin_retier(self, request: web.Request) -> web.Response:
+        """Manually move one replica to the other tier: drain, migrate
+        its live streams off, hot-restart at the target tier's TP width
+        (or re-label an HTTP member), rejoin. Body: {"tier":
+        "interactive"|"bulk", "timeout_s": N?}. Poll GET /admin/tiers
+        until the regroup commits (tier_regroup done in the journal)."""
+        self._ident(request)
+        name = request.match_info["replica"]
+        body = await self._body_json(request)
+        tier = body.get("tier")
+        if not isinstance(tier, str) or not tier:
+            raise ApiError(400, "'tier' must name the target tier")
+        timeout_s = None
+        if "timeout_s" in body:
+            try:
+                timeout_s = float(body["timeout_s"])
+            except (TypeError, ValueError):
+                raise ApiError(400, "'timeout_s' must be a number")
+            if timeout_s <= 0:
+                raise ApiError(400, "'timeout_s' must be > 0")
+        try:
+            out = self.engine.retier_replica(name, tier,
+                                             timeout_s=timeout_s,
+                                             why="admin")
+        except AttributeError:
+            raise ApiError(404, "fleet is untiered (--tiers not set)")
+        except KeyError as e:
+            raise ApiError(404, str(e.args[0]) if e.args else str(e))
+        except ValueError as e:
+            raise ApiError(400, str(e))
         except RuntimeError as e:
             raise ApiError(409, str(e))
         return web.json_response({"status": "success", **out})

@@ -73,6 +73,15 @@ EVENTS = (
     "replica_drain",     # replica quiesced: no new placements, in-flight
     #                      streams run to completion
     "replica_join",      # replica (re)entered rotation, by reason
+    # Tiered fleet (fleet/tiering.py): SLO-aware replica tiers with
+    # adaptive regrouping.
+    "tier_place",        # placement matched a request class to a tier
+    "tier_overflow",     # a stream placed cross-tier (per-tier SLO burn,
+    #                      an empty tier, or a failover with no in-tier
+    #                      capacity) — never silently
+    "tier_regroup",      # a member changed tiers (drain -> migrate ->
+    #                      restart at the tier's TP width -> rejoin),
+    #                      by phase: start / done / aborted
     # KV page migration (two-phase handoff; fleet/router.py + engine):
     "migrate_export",    # source snapshot taken, slot detached/parked
     "migrate_import",    # target installed the shipped state (the ack)
@@ -147,6 +156,17 @@ EVENT_FIELDS: Dict[str, Tuple[tuple, tuple]] = {
     "replica_failover": (("replica",), ("to_replica", "replayed_tokens")),
     "replica_drain": (("replica",), ("inflight", "timeout_s")),
     "replica_join": (("replica",), ("why",)),
+    # Tier records carry the classification inputs: which request class
+    # (vip/boost/deadline/default) mapped to which tier and which
+    # replica won (tier_place); why a stream crossed tiers and how hot
+    # the burn was (tier_overflow); a regroup's phase with the class-mix
+    # EMA and TP widths that justified it (tier_regroup).
+    "tier_place": (("tier", "cls"), ("replica", "overflow")),
+    "tier_overflow": (("from_tier", "to_tier", "why"),
+                      ("burn", "queued", "replica")),
+    "tier_regroup": (("replica", "phase"),
+                     ("from_tier", "to_tier", "why", "mix",
+                      "tp_from", "tp_to")),
     # Migration records carry the shipped state's size (tokens already
     # generated = what recompute would have re-derived; pages/bytes =
     # what actually moved) and, router-side, the members involved.
@@ -180,6 +200,7 @@ DECISION_KINDS = ("enqueue", "admit", "sched", "place", "shed", "batch",
                   "install", "preempt", "requeue", "retry", "poison",
                   "deadline_drop", "finish", "replica_eject",
                   "replica_failover", "replica_drain", "replica_join",
+                  "tier_place", "tier_overflow", "tier_regroup",
                   "migrate_export", "migrate_import", "migrate_abort",
                   "recover_replay")
 
@@ -203,6 +224,9 @@ _SIG_FIELDS = {
     "poison": ("retries",),
     "finish": ("reason",),
     "preempt": ("why",),
+    "tier_place": ("tier", "cls"),
+    "tier_overflow": ("from_tier", "to_tier", "why"),
+    "tier_regroup": ("replica", "phase", "from_tier", "to_tier"),
 }
 
 
@@ -525,6 +549,36 @@ def explain(rec: dict) -> str:
     if kind == "replica_join":
         return (f"replica {rec.get('replica', '?')} joined rotation "
                 f"({rec.get('why', 'start')})")
+    if kind == "tier_place":
+        s = (f"{who} (class {rec.get('cls', '?')}) placed in tier "
+             f"{rec.get('tier', '?')}")
+        if rec.get("replica"):
+            s += f" on replica {rec['replica']}"
+        if rec.get("overflow"):
+            s += " via cross-tier overflow"
+        return s
+    if kind == "tier_overflow":
+        s = (f"{who} overflowed {rec.get('from_tier', '?')} -> "
+             f"{rec.get('to_tier', '?')} ({rec.get('why', '?')})")
+        if rec.get("burn") is not None:
+            s += f", burn {rec['burn']:.1f}x budget"
+        if rec.get("replica"):
+            s += f", landed on {rec['replica']}"
+        return s
+    if kind == "tier_regroup":
+        phase = rec.get("phase", "?")
+        s = (f"replica {rec.get('replica', '?')} regroup "
+             f"{rec.get('from_tier', '?')} -> {rec.get('to_tier', '?')} "
+             f"{phase}")
+        if rec.get("why"):
+            s += f" ({rec['why']})"
+        if rec.get("mix") is not None:
+            s += f", interactive mix EMA {rec['mix']:.2f}"
+        if rec.get("tp_to") is not None:
+            s += (f", tp {rec.get('tp_from', '?')} -> {rec['tp_to']}")
+        if phase == "aborted":
+            s += "; member keeps its ORIGINAL tier"
+        return s
     if kind == "migrate_export":
         s = (f"{who} KV state exported for migration "
              f"({rec.get('tokens', '?')} generated token(s)")
@@ -608,7 +662,12 @@ def check_invariants(records: List[dict],
       5. no admitted request starves past `starve_after` prefill batches
          without progress (install/finish/requeue/retry/shed/preempt);
       6. speculation never accepts more than it proposed — a spec_verify
-         with accepted > proposed fabricated tokens.
+         with accepted > proposed fabricated tokens;
+      7. tier decisions are well-formed — a tier_overflow whose from and
+         to tiers are the same lied about crossing tiers, and a
+         tier_regroup outside the start/done/aborted phase vocabulary is
+         an instrumentation bug (tools/journal check additionally pairs
+         every regroup start with its done/aborted, end-of-run).
 
     `starve_after=None` skips check 5 — sampled journals
     (--journal-sample < 1) drop a fraction of `batch` records, so the
@@ -665,6 +724,17 @@ def check_invariants(records: List[dict],
                 bad.append(
                     f"seq {seq}: preempt victim req {rid} IS the VIP "
                     f"({vip})")
+        if kind == "tier_overflow":
+            ft, tt = r.get("from_tier"), r.get("to_tier")
+            if ft is not None and ft == tt:
+                bad.append(
+                    f"seq {seq}: tier_overflow from and to the same tier "
+                    f"({ft}) for req {rid}")
+        if kind == "tier_regroup" \
+                and r.get("phase") not in ("start", "done", "aborted"):
+            bad.append(
+                f"seq {seq}: tier_regroup phase {r.get('phase')!r} not in "
+                "start/done/aborted")
         if kind == "shed" and r.get("reason") in ("queue_full",
                                                   "user_queue_full"):
             queued, limit = r.get("queued"), r.get("limit")

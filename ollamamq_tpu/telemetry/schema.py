@@ -236,6 +236,25 @@ FLEET_AFFINITY_HITS_TOTAL = REGISTRY.counter(
     "Placements routed to the replica whose prefix-cache radix tree "
     "already held the prompt's prefix (--placement=affinity); misses "
     "fall back to least-loaded")
+FLEET_TIER_MEMBERS = REGISTRY.gauge(
+    "ollamamq_fleet_tier_members",
+    "Fleet members per replica tier by state (healthy / ejected / "
+    "draining) under --tiers; a tier whose healthy count hits 0 is "
+    "serving its traffic cross-tier (journaled tier_overflow) until a "
+    "member heals or regroups in", labels=("tier", "state"))
+FLEET_TIER_OVERFLOW_TOTAL = REGISTRY.counter(
+    "ollamamq_fleet_tier_overflow_total",
+    "Streams placed cross-tier, by (from, to) tier: per-tier SLO "
+    "burn-rate overflow, an empty home tier, or a failover with no "
+    "in-tier capacity — every one journaled as tier_overflow with its "
+    "inputs", labels=("from", "to"))
+FLEET_REGROUPS_TOTAL = REGISTRY.counter(
+    "ollamamq_fleet_regroups_total",
+    "Tier regroups (a member drained, live streams migrated off, "
+    "hot-restarted at the target tier's TP width, rejoined the other "
+    "tier) by outcome: 'done' or 'aborted' (crash/restart failure "
+    "mid-retier; the member keeps its original tier)",
+    labels=("outcome",))
 FLEET_MIGRATIONS_TOTAL = REGISTRY.counter(
     "ollamamq_fleet_migrations_total",
     "KV page migrations between fleet members by outcome: 'migrated' "

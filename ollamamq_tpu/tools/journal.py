@@ -161,6 +161,39 @@ def _dropped_streams(records: List[dict]) -> Tuple[dict, dict]:
     return pending, open_handoff
 
 
+def check_regroup_pairing(records: List[dict]) -> List[str]:
+    """Tiered-fleet regroup audit (end-of-run semantics, like the
+    zero-drop checker): every `tier_regroup` phase="start" must resolve
+    to a "done" or an "aborted" for the same replica by journal end — a
+    start left hanging is a member parked in `draining` with its tier
+    move never committed nor rolled back. A done/aborted with no start
+    in the window is tolerated (the start may have rotated out of a
+    ring tail); the pairing only binds on full spills."""
+    open_regroups: dict = {}  # replica -> seq of the unresolved start
+    bad: List[str] = []
+    for r in records:
+        if r.get("kind") != "tier_regroup":
+            continue
+        rep = r.get("replica")
+        phase = r.get("phase")
+        if phase == "start":
+            prev = open_regroups.get(rep)
+            if prev is not None:
+                bad.append(
+                    f"replica {rep} regroup started at seq "
+                    f"{r.get('seq', '?')} while the start at seq {prev} "
+                    "was never resolved (one regroup at a time)")
+            open_regroups[rep] = r.get("seq", "?")
+        elif phase in ("done", "aborted"):
+            open_regroups.pop(rep, None)
+    bad += [
+        f"replica {rep} regroup UNRESOLVED: tier_regroup start at seq "
+        f"{seq} never reached done/aborted by journal end"
+        for rep, seq in sorted(open_regroups.items())
+    ]
+    return bad
+
+
 def check_stream_attribution(records: List[dict]) -> List[str]:
     """Every stream a recovery touched must reach exactly ONE terminal:
     a failed-over/migrated/WAL-recovered stream with two `finish`
@@ -564,6 +597,8 @@ def check_files(paths: List[str]) -> Tuple[List[str], int]:
                          "other invariants binding")
         bad += [tag + v for v in check_invariants(
             records, starve_after=None if sampled else STARVATION_BATCHES)]
+        if any(r.get("kind") == "tier_regroup" for r in records):
+            bad += [tag + v for v in check_regroup_pairing(records)]
         if not any(r.get("kind", "").startswith(("replica_", "migrate_",
                                                  "recover_"))
                    for r in records):

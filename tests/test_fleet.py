@@ -624,19 +624,22 @@ def test_migration_fuzz_byte_identity_and_page_conservation(
     # Randomized decode depth for the kill, kept shallow enough that
     # the victim member still holds live streams when the eject's
     # migration pass runs (the dying loop finishes its current
-    # iteration first).
+    # iteration first). The budget is generous (48) for the same
+    # reason: a 16-token stream could run out between the depth probe
+    # below and the health sweep noticing the dead loop, leaving the
+    # eject nothing to migrate.
     depth = random.Random(seed).randrange(1, 6)
     golden = _tpu_fleet(n=1, **over)
     try:
         gtexts = [_text(collect(_run(golden, f"mg{i % 2}", p,
-                                     max_tokens=16)))
+                                     max_tokens=48)))
                   for i, p in enumerate(prompts)]
     finally:
         golden.stop()
 
     router = _tpu_fleet(n=2, **over)
     try:
-        reqs = [_run(router, f"mg{i % 2}", p, max_tokens=16)
+        reqs = [_run(router, f"mg{i % 2}", p, max_tokens=48)
                 for i, p in enumerate(prompts)]
         deadline = time.monotonic() + 120
         victim = None

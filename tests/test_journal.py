@@ -103,6 +103,13 @@ _MINIMAL = {
                              replayed_tokens=3),
     "replica_drain": dict(replica="r0", inflight=2, timeout_s=30.0),
     "replica_join": dict(replica="r1", why="heal"),
+    "tier_place": dict(tier="interactive", cls="vip", replica="r0",
+                       overflow=None),
+    "tier_overflow": dict(from_tier="interactive", to_tier="bulk",
+                          why="burn", burn=14.4, queued=3, replica="r1"),
+    "tier_regroup": dict(replica="r1", phase="done", from_tier="bulk",
+                         to_tier="interactive", why="mix_shift", mix=0.8,
+                         tp_from=1, tp_to=4),
     "migrate_export": dict(replica="r1", tokens=5, kv_len=21, pages=3,
                            bytes=4096),
     "migrate_import": dict(replica="r1", to_replica="r0", tokens=5,

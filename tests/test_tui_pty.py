@@ -258,6 +258,40 @@ def test_tui_last_decision_line_via_pty(tmp_path):
         t.close()
 
 
+# Engine stub shaped like a tiered fleet router: the chips panel must
+# render the replicas line AND the tiers line (healthy/total per tier) —
+# here with a starved interactive tier (0 healthy), the red case.
+_CHILD_TIERS = _CHILD.replace(
+    'eng.runtimes = {}\nadmin_tui.run_tui(eng, None, refresh_ms=50)',
+    '''eng.runtimes = {}
+class _Tiers:
+    def counts(self):
+        return {"interactive": {"healthy": 0, "total": 1},
+                "bulk": {"healthy": 2, "total": 2}}
+eng.tiers = _Tiers()
+eng.fleet_counts = lambda: {"healthy": 2, "ejected": 1, "draining": 0}
+admin_tui.run_tui(eng, None, refresh_ms=50)''')
+assert _CHILD_TIERS != _CHILD, "tiers child patch failed to apply"
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="pty/termios test")
+def test_tui_tiers_line_via_pty(tmp_path):
+    """Tiered-fleet TUI: the tiers line renders healthy/total per tier
+    in the rendered frames (red when a tier has zero healthy members —
+    asserted on content; the color is the C++ side's starved flag)."""
+    t = _PtyTui(tmp_path, child_src=_CHILD_TIERS)
+    try:
+        assert t.wait_output(b"replicas 2 healthy / 1 ejected"), _stderr(t)
+        assert t.wait_output(b"tiers"), _stderr(t)
+        assert t.wait_output(b"interactive 0/1"), _stderr(t)
+        assert t.wait_output(b"bulk 2/2"), _stderr(t)
+        t.send("q")
+        assert t.wait_output(b"TUI_EXIT_OK"), _stderr(t)
+        assert t.proc.wait(timeout=30) == 0
+    finally:
+        t.close()
+
+
 @pytest.mark.skipif(sys.platform != "linux", reason="pty/termios test")
 def test_tui_no_alerts_renders_quiet_panel(tmp_path):
     """Without an alert table (or with it empty) the ALERTS section still
