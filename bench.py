@@ -1167,6 +1167,13 @@ def _fleet_scenario(args, rng, touch):
                 "failovers": router.failover_count,
                 "migrations": router.migration_count,
                 "migrate_aborts": router.migrate_abort_count,
+                # Router-overhead self-profiling: this leg's windowed
+                # placement p99 (the per-instance window, NOT the
+                # process-cumulative histogram, so legs don't bleed
+                # into each other's gate).
+                "router_overhead_p99_ms": router.router_overhead_p99_ms(),
+                "router_overhead_budget_ms":
+                    ecfg.router_overhead_budget_ms,
                 "elapsed_s": round(time.monotonic() - t0, 3),
             }
         finally:
@@ -1266,10 +1273,24 @@ def _fleet_scenario(args, rng, touch):
             and dropped == 0 and rec_dropped == 0
             and not violations and not rec_violations),
     }
+    # Router-overhead gate (ROADMAP: "router overhead (placement +
+    # journal) measured and bounded"): the CHAOS leg's windowed
+    # placement p99 must come in under the configured budget — chaos is
+    # exactly when an unbounded router hot path would hide behind the
+    # failover noise.
+    overhead_p99 = chaos["router_overhead_p99_ms"]
+    overhead_budget = chaos["router_overhead_budget_ms"]
+    overhead_pass = bool(overhead_p99 is not None
+                         and (not overhead_budget
+                              or overhead_p99 <= overhead_budget))
     return {
         "requests": n_total,
         "replicas": n_members,
         "max_new_tokens": max_new,
+        "router_overhead_p99_ms": (round(overhead_p99, 4)
+                                   if overhead_p99 is not None else None),
+        "router_overhead_budget_ms": overhead_budget,
+        "router_overhead_pass": overhead_pass,
         "ejects": sum(1 for r in jrecs if r["kind"] == "replica_eject"),
         "failovers": chaos["failovers"],
         "drains": sum(1 for r in jrecs if r["kind"] == "replica_drain"),
@@ -1429,6 +1450,9 @@ def _tiering_scenario(args, rng, touch):
             # spill files below keep everything for the audit).
             out["overflows"] = (router.tiers.overflow_count
                                 if router.tiers is not None else 0)
+            p99 = router.router_overhead_p99_ms()
+            out["router_overhead_p99_ms"] = (round(p99, 4)
+                                             if p99 is not None else None)
             out["invariant_violations"] = len(
                 check_invariants(router.journal.tail(None)))
             return out, spills
@@ -1506,6 +1530,7 @@ def _tiering_scenario(args, rng, touch):
     return {
         "interactive_requests": n_short,
         "bulk_requests": n_bulk,
+        "router_overhead_p99_ms": tiered.get("router_overhead_p99_ms"),
         "tiered": tiered,
         "homogeneous_latency_grade": homo_lat,
         "homogeneous_throughput_grade": homo_thr,
@@ -1721,6 +1746,21 @@ def _crash_restart_scenario(args, touch):
         golden_ok = all(c.text == golden_text for c in golden_clients)
         id_exact = all(c.ids == list(range(1, max_new + 1))
                        for c in chaos_clients if c.done_reason)
+        # Router-overhead readout off the RESTARTED router's own stats
+        # surface (/metrics.json → fleet.router_overhead): the crash
+        # leg's recovery placements are the router hot path under the
+        # worst realistic conditions.
+        overhead_p99 = None
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{ports['router']}/metrics.json",
+                    timeout=10) as r:
+                stats = _json.loads(r.read())
+            overhead_p99 = ((stats.get("fleet") or {})
+                            .get("router_overhead") or {}).get(
+                                "place_p99_ms")
+        except Exception:  # noqa: BLE001 — readout only, never the gate
+            pass
         # Graceful close of the restarted router flushes its spill, so
         # the audit reads a complete journal.
         router2.send_signal(15)
@@ -1736,6 +1776,7 @@ def _crash_restart_scenario(args, touch):
         return {
             "requests": n,
             "max_new_tokens": max_new,
+            "router_overhead_p99_ms": overhead_p99,
             "recovered_streams": recovered,
             "dropped_streams": dropped,
             "silent_truncations": silent,

@@ -406,6 +406,29 @@ struct Tui {
                     " replicas %.0f healthy / %.0f ejected / %.0f draining",
                     fh, fe, fd);
       out.push_back(std::string(fe > 0 ? RED : CYAN) + l + RST);
+      /* Router-overhead chip (its own line — the chips column is a
+       * third of the terminal): the windowed placement-decision p99 vs
+       * its budget (ollamamq_router_overhead_ms{site="place"}). RED
+       * when the router hot path itself is over budget — the fleet is
+       * paying routing tax on every stream, not just serving slower. */
+      auto ro = stats->get("router_overhead");
+      if (ro && ro->type == mj::Value::OBJ) {
+        bool over = false;
+        if (ro->get("p99_ms") && ro->get("p99_ms")->type == mj::Value::NUM) {
+          double p99 = ro->get("p99_ms")->as_num();
+          double budget = ro->get("budget_ms")
+                              ? ro->get("budget_ms")->as_num() : 0;
+          over = budget > 0 && p99 > budget;
+          if (budget > 0)
+            std::snprintf(l, sizeof l,
+                          " router p99 %.2fms (budget %.0fms)", p99, budget);
+          else
+            std::snprintf(l, sizeof l, " router p99 %.2fms", p99);
+        } else {
+          std::snprintf(l, sizeof l, " router p99 n/a");
+        }
+        out.push_back(std::string(over ? RED : CYAN) + l + RST);
+      }
     }
     /* Tiers line (tiered fleets only): healthy/total per replica tier.
      * RED when any tier has ZERO healthy members — that tier's traffic

@@ -25,6 +25,8 @@ set -- --no-tui --host 0.0.0.0
 [ "${MIGRATE:-}" = "false" ] && set -- "$@" --no-migrate
 [ -n "${MIGRATE_TIMEOUT_S:-}" ] && set -- "$@" --migrate-timeout-s "$MIGRATE_TIMEOUT_S"
 [ -n "${TIERS:-}" ] && set -- "$@" --tiers "$TIERS"
+[ -n "${ROUTER_OVERHEAD_BUDGET_MS:-}" ] && set -- "$@" --router-overhead-budget-ms "$ROUTER_OVERHEAD_BUDGET_MS"
+[ "${FEDERATE_METRICS:-}" = "false" ] && set -- "$@" --no-federate-metrics
 [ -n "${MAX_SLOTS:-}" ] && set -- "$@" --max-slots "$MAX_SLOTS"
 [ -n "${WAL_DIR:-}" ] && set -- "$@" --wal-dir "$WAL_DIR"
 [ -n "${WAL_FSYNC_MS:-}" ] && set -- "$@" --wal-fsync-ms "$WAL_FSYNC_MS"

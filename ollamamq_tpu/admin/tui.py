@@ -114,6 +114,20 @@ def _engine_stats_brief(engine) -> dict:
             out["replicas"] = fleet()
         except Exception:
             pass
+    # Router-overhead chip (fleet router only): the windowed placement
+    # p99 against its budget — red in the C++ renderer when the router
+    # hot path itself is eating the latency budget.
+    overhead = getattr(engine, "router_overhead_p99_ms", None)
+    if overhead is not None:
+        try:
+            p99 = overhead()
+            out["router_overhead"] = {
+                "p99_ms": round(p99, 3) if p99 is not None else None,
+                "budget_ms": getattr(engine.ecfg,
+                                     "router_overhead_budget_ms", 0.0),
+            }
+        except Exception:
+            pass
     # Tiers line (tiered fleets only): healthy/total per tier — the C++
     # side renders it red when any tier has ZERO healthy members (that
     # tier's traffic is running cross-tier until a member heals in).

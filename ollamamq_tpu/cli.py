@@ -183,6 +183,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "(drain -> migrate -> restart -> rejoin) as the "
                         "class mix shifts. Unknown tier names or a tier "
                         "with no members fail startup")
+    p.add_argument("--router-overhead-budget-ms", type=float,
+                   default=float(os.environ.get(
+                       "ROUTER_OVERHEAD_BUDGET_MS", 50.0)),
+                   help="bound on the router's own placement-decision "
+                        "cost: the always-on self-profiler "
+                        "(ollamamq_router_overhead_ms{site}) feeds a "
+                        "windowed p99; above this budget the health "
+                        "monitor fires the router_overhead alert and "
+                        "the bench fleet-chaos gate fails. 0 disables "
+                        "the alert (the timers stay on)")
+    p.add_argument("--no-federate-metrics", action="store_true",
+                   default=os.environ.get("FEDERATE_METRICS", "").lower()
+                   in ("0", "false", "no"),
+                   help="disable metrics federation: the router's "
+                        "/metrics stops re-exporting HTTP members' "
+                        "series under a replica label (members stay "
+                        "scrapable individually)")
     # Graceful degradation under load.
     p.add_argument("--max-queued", type=int, default=0,
                    help="global queued-request cap: past it, enqueues are "
@@ -476,6 +493,10 @@ def main(argv=None) -> int:
     if args.migrate_timeout_s <= 0:
         log.error("--migrate-timeout-s must be > 0")
         return 2
+    if args.router_overhead_budget_ms < 0:
+        log.error("--router-overhead-budget-ms must be >= 0 "
+                  "(0 disables the alert)")
+        return 2
     if args.tiers:
         # Tier spec fails fast BEFORE any device work: unknown tier
         # names, selectors naming no member, and a tier with no members
@@ -603,6 +624,8 @@ def main(argv=None) -> int:
         migrate=not args.no_migrate,
         migrate_timeout_s=args.migrate_timeout_s,
         tiers=args.tiers or None,
+        router_overhead_budget_ms=args.router_overhead_budget_ms,
+        federate_metrics=not args.no_federate_metrics,
     )
     fairness = Fairness.TOKENS if args.token_fairness else Fairness.REQUESTS
 

@@ -344,6 +344,19 @@ class EngineConfig:
     # this aborts and falls back to recompute — a stalled transfer must
     # never hold a stream hostage longer than re-deriving it would.
     migrate_timeout_s: float = 10.0
+    # Router-overhead bound: the always-on self-profiler times every
+    # placement decision (ollamamq_router_overhead_ms{site="place"});
+    # a windowed p99 above this budget fires the health monitor's
+    # router_overhead alert and fails the bench fleet-chaos gate —
+    # "router overhead measured and bounded". 0 disables the alert
+    # (the timers stay on: measurement is not optional).
+    router_overhead_budget_ms: float = 50.0
+    # Metrics federation: re-export every HTTP member's series from the
+    # router's /metrics with a `replica` label (scraped on the member
+    # health heartbeat), so one Prometheus target sees the fleet.
+    # LocalMembers share the router process's registry and are always
+    # in the local exposition regardless.
+    federate_metrics: bool = True
     # -- tiered fleet (fleet/tiering.py) -------------------------------------
     # Replica-tier spec: latency-sensitive traffic (VIP/boost users,
     # deadlined requests) places on the `interactive` tier, everything

@@ -3530,12 +3530,18 @@ class TPUEngine:
         kind: str = "generate",
         raw_prompt: str = "",
         context_ids=None,
+        trace_ctx=None,
     ) -> Request:
         """Atomically enqueue into the native core AND register the Request,
         so the engine loop can never pop a req_id it doesn't know yet.
         Raises BlockedError for blocked users/IPs, QueueFullError when a
         bounded-admission cap (--max-queued / --max-queued-per-user) is
         hit — honest backpressure instead of an unbounded queue.
+
+        `trace_ctx` (the `traceparent` header / fleet router context):
+        a propagated fleet-stable trace id this request's spans adopt,
+        so a member process's timeline stitches under the router's rid
+        at GET /debug/trace/{rid}. None mints a fresh root context.
 
         `context_ids` (Ollama's /api/generate `context` field, also the
         fleet's token-space HTTP failover replay): token ids already
@@ -3592,7 +3598,8 @@ class TPUEngine:
                 req.generated_ids = list(ctx)
                 req._replay_gen = len(ctx)
                 req.stats.prompt_tokens = len(req.prompt_tokens)
-            req.trace = self.tracer.begin(rid, user, model, kind=kind)
+            req.trace = self.tracer.begin(rid, user, model, kind=kind,
+                                          ctx=trace_ctx)
             self.pending[rid] = req
         self.journal.record(
             "enqueue", req=req, n_prompt=len(req.prompt_tokens),
@@ -3630,20 +3637,32 @@ class TPUEngine:
         self.notify()
 
     def inject_request(self, req: Request, ip: str = "",
-                       family=None) -> Request:
+                       family=None, trace_ctx=None,
+                       trace_meter: bool = True) -> Request:
         """Fleet handoff seam: atomically enqueue AND register a
         PRE-BUILT Request (the fleet router's attempt objects, which may
         carry replayed generation state — generated_ids, detokenizer,
         penalty context folded into the prompt — that enqueue_request
         could not construct). Bypasses bounded admission on purpose: the
         router owns the fleet-wide caps; a member must never second-guess
-        a placement the router already admitted."""
+        a placement the router already admitted.
+
+        `trace_ctx` gives the member-side attempt its own Trace under
+        the router's fleet context, so its prefill/decode spans stitch
+        into the client's /debug/trace/{rid} timeline. `trace_meter`
+        False = an in-process LocalMember attempt: the router's root
+        trace already meters this stream into requests_inflight/total —
+        the member copy must not double-count the shared registry."""
         with self._pending_lock:
             rid = self.core.enqueue(
                 req.user, ip, req.model,
                 family if family is not None else Family.UNKNOWN,
                 kind=req.kind)
             req.req_id = rid
+            if trace_ctx is not None and req.trace is None:
+                req.trace = self.tracer.begin(
+                    rid, req.user, req.model, kind=req.kind,
+                    ctx=trace_ctx, metered=trace_meter)
             self.pending[rid] = req
         self.journal.record(
             "enqueue", req=req, n_prompt=len(req.prompt_tokens),
@@ -3760,12 +3779,15 @@ class TPUEngine:
         return self.call_on_loop(_do)
 
     def import_stream(self, blob: dict, ip: str = "", family=None,
-                      deadline: Optional[float] = None) -> Request:
+                      deadline: Optional[float] = None, trace_ctx=None,
+                      trace_meter: bool = True) -> Request:
         """Target side of a migration: rebuild the Request and install
         it DIRECTLY into a decode slot from the shipped pages — no
         queue wait, no re-prefill. Raises MigrationError when it cannot
         land (caller falls back to recompute). Bypasses bounded
-        admission like inject_request: the router already admitted."""
+        admission like inject_request: the router already admitted.
+        `trace_ctx`/`trace_meter` as in inject_request: the continuation
+        traces under the router's fleet context."""
         state = blob.get("request") or {}
         if not state.get("user"):
             raise MigrationError("malformed migration blob (no request)")
@@ -3781,6 +3803,10 @@ class TPUEngine:
             req = request_from_migration_state(rid, state)
             req._inc_decode = blob.get("_inc_decode")
             req.deadline = deadline
+            if trace_ctx is not None:
+                req.trace = self.tracer.begin(
+                    rid, req.user, req.model, kind=req.kind,
+                    ctx=trace_ctx, metered=trace_meter)
             rt = self.resolve_runtime(state.get("model"), kind="generate")
             if rt is None:
                 raise MigrationError(

@@ -254,6 +254,7 @@ class HealthMonitor:
 
         self._check_preempt_storm()
         self._check_regroup_storm()
+        self._check_router_overhead()
         self._check_journal_invariants()
 
         slo = getattr(self.engine, "slo", None)
@@ -325,6 +326,38 @@ class HealthMonitor:
                 "drain + migrations + a restart", source="watchdog")
         else:
             alerts.resolve("regroup_storm")
+
+    def _check_router_overhead(self) -> None:
+        """Overhead-storm rule (fleet routers only: the engine exposes
+        router_overhead_p99_ms). The router's own placement-decision
+        cost is supposed to be noise next to serving; a windowed p99
+        above --router-overhead-budget-ms means the router hot path
+        itself is eating the latency budget (an affinity probe scanning
+        a huge radix tree, GIL contention with co-located members, a
+        journal spill on a dying disk). Degradation pressure like the
+        preempt storm — it bypasses _alert and its stall counter — and
+        it RESOLVES as the window ages the spike out."""
+        alerts = getattr(self.engine, "alerts", None)
+        p99_fn = getattr(self.engine, "router_overhead_p99_ms", None)
+        if alerts is None or p99_fn is None:
+            return
+        budget = getattr(getattr(self.engine, "ecfg", None),
+                         "router_overhead_budget_ms", None)
+        if not budget:
+            return
+        try:
+            p99 = p99_fn()
+        except Exception:  # noqa: BLE001
+            log.exception("router overhead read failed")
+            return
+        if p99 is not None and p99 > budget:
+            alerts.fire(
+                "router_overhead", "warn",
+                f"router overhead storm: placement p99 {p99:.2f}ms over "
+                f"the {budget:g}ms budget — the router hot path itself "
+                "is eating the latency budget", source="watchdog")
+        else:
+            alerts.resolve("router_overhead")
 
     def _check_journal_invariants(self) -> None:
         """Flight-recorder invariant sweep over the decision-journal ring

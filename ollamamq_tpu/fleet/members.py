@@ -35,6 +35,7 @@ import urllib.request
 from typing import Optional
 
 from ollamamq_tpu.engine.request import FinishReason, Request, StreamItem
+from ollamamq_tpu.telemetry.tracing import TRACEPARENT_HEADER
 
 log = logging.getLogger("ollamamq.fleet")
 
@@ -145,6 +146,21 @@ class _MemberBase:
     def force_stale(self, delay_s: float) -> None:
         self.forced_stale_until = time.monotonic() + float(delay_s)
 
+    # -- fleet observability (overridden per shape) ------------------------
+    def trace_spans(self, ctx: str) -> list:
+        """This member's exported trace spans for one fleet context —
+        the stitching wire behind GET /debug/trace/{rid}."""
+        return []
+
+    def metric_snapshot(self):
+        """Registry snapshot for metrics federation (None = nothing to
+        re-export: LocalMembers share the router process's registry)."""
+        return None
+
+    def bundle(self) -> dict:
+        """Per-member diagnostics for the router's /debug/bundle."""
+        return {}
+
 
 class LocalMember(_MemberBase):
     """An in-process engine replica. The engine was constructed by the
@@ -162,6 +178,10 @@ class LocalMember(_MemberBase):
         # that declares a width change falls back to a re-label +
         # same-width hot restart.
         self.engine_factory = engine_factory
+        # Member-side spans stitch under this member's name, not the
+        # generic "engine" origin.
+        if getattr(engine, "tracer", None) is not None:
+            engine.tracer.origin = name
 
     @property
     def tp(self) -> Optional[int]:
@@ -232,6 +252,8 @@ class LocalMember(_MemberBase):
             old.start()  # the member must not stay dead over a bad width
             raise
         self.engine = fresh
+        if getattr(fresh, "tracer", None) is not None:
+            fresh.tracer.origin = self.name
         fresh.start()
         return self.tp
 
@@ -325,7 +347,12 @@ class LocalMember(_MemberBase):
             att.text_mode = True
             att.base_n = int(resume.get("n_gen", 0))
             att.prior_text = resume.get("text", "")
-        self.engine.inject_request(req, ip=flight.ip, family=flight.family)
+        # trace_meter=False: the router's root trace already meters this
+        # stream in the SHARED process registry — the member-side copy
+        # exists only so its prefill/decode spans stitch under the
+        # client rid.
+        self.engine.inject_request(req, ip=flight.ip, family=flight.family,
+                                   trace_ctx=flight.ctx, trace_meter=False)
         return att
 
     def cancel(self, att: Attempt) -> None:
@@ -357,7 +384,8 @@ class LocalMember(_MemberBase):
         commit waits on is this returning)."""
         req = self.engine.import_stream(
             blob, ip=flight.ip, family=flight.family,
-            deadline=flight.req.deadline)
+            deadline=flight.req.deadline,
+            trace_ctx=flight.ctx, trace_meter=False)
         if on_item is not None:
             req.stream.on_item = on_item
         return Attempt(req, self)
@@ -369,6 +397,29 @@ class LocalMember(_MemberBase):
     def import_prefix(self, model: str, blob: dict) -> int:
         fn = getattr(self.engine, "import_prefix", None)
         return fn(model, blob) if fn is not None else 0
+
+    # -- fleet observability ----------------------------------------------
+    def trace_spans(self, ctx: str) -> list:
+        tracer = getattr(self.engine, "tracer", None)
+        if tracer is None:
+            return []
+        return tracer.export_spans(tracer.find_ctx(ctx))
+
+    def bundle(self) -> dict:
+        """Compact per-member diagnostics for the router's bundle: an
+        in-process member needs no HTTP round-trip — read its surfaces
+        directly (error containment lives at the router's section
+        builder)."""
+        eng = self.engine
+        out: dict = {"kind": "local", "tier": self.tier}
+        out["stats"] = eng.stats()
+        alerts = getattr(eng, "alerts", None)
+        out["alerts"] = alerts.to_dict() if alerts is not None else None
+        journal = getattr(eng, "journal", None)
+        if journal is not None:
+            out["journal"] = {**journal.snapshot(),
+                              "events": journal.tail(n=100)}
+        return out
 
 
 class HttpMember(_MemberBase):
@@ -388,6 +439,11 @@ class HttpMember(_MemberBase):
         self._forced_down = False
         self._last_ok = time.monotonic()
         self._status: dict = {}
+        # Metrics federation: the member's registry snapshot, scraped on
+        # the SAME health heartbeat (one extra GET per poll) so the
+        # router's /metrics re-exports every member series with a
+        # replica label. None until the first successful scrape.
+        self._metric_snapshot: Optional[dict] = None
         self._stop = threading.Event()
         self._poller: Optional[threading.Thread] = None
 
@@ -440,6 +496,17 @@ class HttpMember(_MemberBase):
                     self._status = json.loads(resp.read())
                 self._last_ok = time.monotonic()
             except Exception:  # noqa: BLE001 — staleness IS the signal
+                continue
+            # Federation scrape rides the SAME heartbeat: a member whose
+            # /health answers but whose snapshot endpoint fails (old
+            # member build, transient error) keeps its LAST snapshot —
+            # health and federation degrade independently.
+            try:
+                with urllib.request.urlopen(
+                        self.url + "/metrics/snapshot",
+                        timeout=2.0) as resp:
+                    self._metric_snapshot = json.loads(resp.read())
+            except Exception:  # noqa: BLE001
                 pass
 
     # -- health ------------------------------------------------------------
@@ -466,6 +533,37 @@ class HttpMember(_MemberBase):
 
     def affinity_pages(self, model: str, tokens) -> int:
         return 0  # no cross-process radix probe; falls back to least-loaded
+
+    # -- fleet observability ----------------------------------------------
+    def metric_snapshot(self) -> Optional[dict]:
+        return self._metric_snapshot
+
+    def trace_spans(self, ctx: str) -> list:
+        """Fetch this member process's spans for one fleet context
+        (GET /debug/trace?ctx=...). The member's generic 'engine' origin
+        is relabeled with the member NAME so the stitched timeline says
+        which replica served each span."""
+        try:
+            with urllib.request.urlopen(
+                    f"{self.url}/debug/trace?ctx={ctx}",
+                    timeout=5.0) as resp:
+                spans = json.loads(resp.read()).get("spans") or []
+        except Exception:  # noqa: BLE001 — a dead member has no spans
+            return []
+        for span in spans:
+            if span.get("origin") in (None, "engine"):
+                span["origin"] = self.name
+        return spans
+
+    def bundle(self) -> dict:
+        """The member's own /debug/bundle, fetched whole (it is already
+        redacted and section-error-contained member-side)."""
+        with urllib.request.urlopen(self.url + "/debug/bundle",
+                                    timeout=10.0) as resp:
+            out = json.loads(resp.read())
+        out["kind"] = "http"
+        out["tier"] = self.tier
+        return out
 
     # -- streams -----------------------------------------------------------
     def begin(self, flight, resume: Optional[dict], on_item=None) -> Attempt:
@@ -548,6 +646,11 @@ class HttpMember(_MemberBase):
                     body["context"] = att.context_ids
                 headers = {"Content-Type": "application/json",
                            "X-User-ID": flight.user}
+                if flight.ctx:
+                    # Fleet trace propagation: the member adopts the
+                    # router's context so its spans stitch under the
+                    # client rid at GET /debug/trace/{rid}.
+                    headers[TRACEPARENT_HEADER] = flight.ctx
                 if flight.req.deadline is not None:
                     left_ms = (flight.req.deadline - time.monotonic()) * 1e3
                     headers["X-Deadline-Ms"] = str(max(1.0, left_ms))
@@ -675,6 +778,8 @@ class HttpMember(_MemberBase):
                                    "")[:int(state.get("emitted_len", 0))]
         headers = {"Content-Type": "application/octet-stream",
                    "X-User-ID": flight.user}
+        if flight.ctx:
+            headers[TRACEPARENT_HEADER] = flight.ctx
         if flight.req.deadline is not None:
             left_ms = (flight.req.deadline - time.monotonic()) * 1e3
             headers["X-Deadline-Ms"] = str(max(1.0, left_ms))

@@ -43,6 +43,7 @@ tracer / health ...), so server/app.py serves a fleet unchanged.
 
 from __future__ import annotations
 
+import collections
 import copy
 import logging
 import threading
@@ -80,11 +81,18 @@ class _Flight:
                  "raw_prompt", "prompt_tokens", "sampling", "member",
                  "attempt", "resume", "failed_from", "evac_since",
                  "evac_deadline", "begin_failures", "done",
-                 "migrate_tried", "tier", "cls")
+                 "migrate_tried", "tier", "cls", "ctx", "place_ms")
 
     def __init__(self, req: Request, ip: str, family) -> None:
         self.req = req
         self.rid0 = req.req_id
+        # Fleet-stable trace context, minted at router admission and
+        # propagated to every member attempt (in-process / traceparent
+        # header) so all processes' spans stitch under rid0.
+        self.ctx = req.trace.ctx if req.trace is not None else None
+        # Router overhead of the LAST placement decision for this
+        # flight (perf-counter ms) — journaled on the place record.
+        self.place_ms: Optional[float] = None
         self.user = req.user
         self.ip = ip
         self.model = req.model
@@ -158,7 +166,15 @@ class FleetRouter:
         self._thread: Optional[threading.Thread] = None
         self.started_at = time.time()
         self.last_tick_at = time.monotonic()
-        self.tracer = Tracer(capacity=engine_cfg.trace_ring)
+        self.tracer = Tracer(capacity=engine_cfg.trace_ring,
+                             origin="router")
+        # Router-overhead self-profiling: a rolling window of placement
+        # decision costs (ms) behind router_overhead_p99_ms() — the
+        # health monitor's overhead-storm alert and the bench gate read
+        # the windowed p99 so a one-off spike ages out; the cumulative
+        # story lives in the ollamamq_router_overhead_ms histogram.
+        self._place_window: collections.deque = collections.deque(
+            maxlen=512)
         self.alerts = AlertManager()
         # The router's SLOEngine exists for the shared alert/evaluate
         # surface; latency objectives stay member-side (each member's
@@ -178,6 +194,24 @@ class FleetRouter:
             keep=engine_cfg.journal_keep,
             sample=getattr(engine_cfg, "journal_sample", 1.0),
             meta=meta)
+        # Always-on journal-record self-timer: every flight-recorder
+        # append the ROUTER makes lands in
+        # ollamamq_router_overhead_ms{site="journal"} — the "journal"
+        # half of ROADMAP's "router overhead (placement + journal)
+        # measured and bounded". Wrapped at the instance so every
+        # record site (and TierManager, which shares this journal)
+        # is covered without touching them.
+        _record = self.journal.record
+        _jhist = tm.ROUTER_OVERHEAD_MS.labels(site="journal")
+
+        def _timed_record(kind, *a, **kw):
+            t0 = time.perf_counter_ns()
+            try:
+                return _record(kind, *a, **kw)
+            finally:
+                _jhist.observe((time.perf_counter_ns() - t0) / 1e6)
+
+        self.journal.record = _timed_record
         self.health = None
         self.shed_counts: Dict[str, int] = {}
         self.failover_count = 0
@@ -397,7 +431,7 @@ class FleetRouter:
                         prompt_tokens=None, sampling=None,
                         kind: str = "generate",
                         raw_prompt: str = "",
-                        context_ids=None) -> Request:
+                        context_ids=None, trace_ctx=None) -> Request:
         """Fleet-wide bounded admission + fair-share enqueue. Mirrors
         TPUEngine.enqueue_request; the caps apply to the ROUTER queue
         (members run uncapped — the router already admitted).
@@ -449,7 +483,8 @@ class FleetRouter:
                 req.sampling = sp
                 req.generated_ids = list(ctx)
                 req._replay_gen = len(ctx)
-            req.trace = self.tracer.begin(rid, user, model, kind=kind)
+            req.trace = self.tracer.begin(rid, user, model, kind=kind,
+                                          ctx=trace_ctx)
             flight = _Flight(req, ip, family if family is not None
                              else Family.UNKNOWN)
             if context_ids:
@@ -467,7 +502,15 @@ class FleetRouter:
         if self.durability is not None:
             # Fsync-before-ACK, same contract as the single engine; the
             # router's prompt is already pristine (members fold replay).
-            self.durability.admit(req, prompt_tokens=prompt_tokens or [])
+            # The gate's full hold (group-commit wait + fsync) is a
+            # router hot-path cost: measured always-on.
+            t0 = time.perf_counter_ns()
+            try:
+                self.durability.admit(req,
+                                      prompt_tokens=prompt_tokens or [])
+            finally:
+                tm.ROUTER_OVERHEAD_MS.labels(site="wal_fsync").observe(
+                    (time.perf_counter_ns() - t0) / 1e6)
         self.notify()
         return req
 
@@ -552,6 +595,21 @@ class FleetRouter:
         cap = mem.slot_cap() if hasattr(mem, "slot_cap") else 0
         return cap or self.ecfg.max_slots
 
+    def _choose_member_timed(self, flight: _Flight):
+        """The placement decision under the always-on overhead timer:
+        every pick (fresh placement, failover re-dispatch, evacuation)
+        lands in ollamamq_router_overhead_ms{site="place"} and the
+        rolling window behind router_overhead_p99_ms() — the bounded
+        number in ROADMAP's 'router overhead measured and bounded'."""
+        t0 = time.perf_counter_ns()
+        try:
+            return self._choose_member(flight)
+        finally:
+            ms = (time.perf_counter_ns() - t0) / 1e6
+            flight.place_ms = ms
+            self._place_window.append(ms)
+            tm.ROUTER_OVERHEAD_MS.labels(site="place").observe(ms)
+
     def _choose_member(self, flight: _Flight):
         elig = [m for m in self.members
                 if self._can_place(m, flight.model, flight.kind)]
@@ -629,7 +687,7 @@ class FleetRouter:
             if flight.req.expired():
                 self._expire(flight)
                 continue
-            mem = self._choose_member(flight)
+            mem = self._choose_member_timed(flight)
             if mem is None:
                 # Capacity raced away between the gate and the pick — or
                 # the flight's home TIER is full (tier isolation: it
@@ -675,12 +733,18 @@ class FleetRouter:
                 "replica_failover", req_id=flight.rid0, user=flight.user,
                 model=flight.model or None, replica=flight.failed_from,
                 to_replica=mem.name, replayed_tokens=replayed)
+            flight.req.trace_event("failover", src=flight.failed_from,
+                                   dst=mem.name, replayed=replayed)
             log.warning("req %d failed over %s -> %s (%d token(s) replayed)",
                         flight.rid0, flight.failed_from, mem.name, replayed)
             flight.failed_from = None
+        overhead = (round(flight.place_ms, 4)
+                    if flight.place_ms is not None else None)
         self.journal.record("place", req_id=flight.rid0, user=flight.user,
-                            model=flight.model or None, runtime=mem.name)
-        flight.req.trace_event("place", runtime=mem.name)
+                            model=flight.model or None, runtime=mem.name,
+                            overhead_ms=overhead)
+        flight.req.trace_event("place", runtime=mem.name,
+                               overhead_ms=overhead)
         if not flight.req.started:
             self.core.mark_started(flight.user)
             flight.req.started = True
@@ -856,12 +920,16 @@ class FleetRouter:
         if self._choose_migration_target(flight, source) is None:
             return "intact"
         deadline = time.monotonic() + self.migrate_timeout_s
+        t_export = time.perf_counter_ns()
         try:
             blob = source.export_stream(att, deadline)
         except Exception:  # noqa: BLE001 — unexportable => recompute
             log.exception("migration export of req %d from %s failed",
                           flight.rid0, source.name)
             blob = None
+        export_ms = (time.perf_counter_ns() - t_export) / 1e6
+        tm.ROUTER_OVERHEAD_MS.labels(site="migrate_export").observe(
+            export_ms)
         if blob is None:
             return "intact"
         nbytes = kvc.migration_blob_bytes(blob)
@@ -871,7 +939,9 @@ class FleetRouter:
             "migrate_export", req_id=flight.rid0, user=flight.user,
             model=flight.model or None, replica=source.name,
             tokens=n_gen, kv_len=blob.get("kv_len"),
-            pages=blob.get("n_pages"), bytes=nbytes)
+            pages=blob.get("n_pages"), bytes=nbytes,
+            overhead_ms=round(export_ms, 4))
+        t_ship = time.perf_counter_ns()
         abort_why = None
         # Fault site "migrate": chaos kills the transfer at every phase
         # of the handoff — mid-flight failure, a stall past the budget,
@@ -896,8 +966,12 @@ class FleetRouter:
             target = self._choose_migration_target(flight, source)
             if target is None:
                 abort_why = "no_target"
+        tm.ROUTER_OVERHEAD_MS.labels(site="migrate_ship").observe(
+            (time.perf_counter_ns() - t_ship) / 1e6)
         new_att = None
+        import_ms = 0.0
         if abort_why is None:
+            t_import = time.perf_counter_ns()
             try:
                 new_att = target.import_stream(blob, flight,
                                                on_item=self.notify)
@@ -905,6 +979,9 @@ class FleetRouter:
                 log.warning("migration import of req %d on %s failed: %s",
                             flight.rid0, target.name, e)
                 abort_why = "import_failed"
+            import_ms = (time.perf_counter_ns() - t_import) / 1e6
+            tm.ROUTER_OVERHEAD_MS.labels(site="migrate_import").observe(
+                import_ms)
         if abort_why is not None:
             try:
                 source.resolve_export(att, commit=False, why=abort_why)
@@ -963,12 +1040,20 @@ class FleetRouter:
             "migrate_import", req_id=flight.rid0, user=flight.user,
             model=flight.model or None, replica=source.name,
             to_replica=target.name, tokens=n_gen,
-            pages=blob.get("n_pages"), bytes=nbytes)
+            pages=blob.get("n_pages"), bytes=nbytes,
+            overhead_ms=round(import_ms, 4))
         self.journal.record("place", req_id=flight.rid0, user=flight.user,
                             model=flight.model or None,
                             runtime=target.name)
         flight.req.trace_event("migrate", src=source.name,
                                dst=target.name, why=why)
+        if why == "retier":
+            # A regroup's drain evacuated this stream: its trace says so
+            # explicitly (the router-span vocabulary's "regroup" row).
+            flight.req.trace_event("regroup", src=source.name,
+                                   dst=target.name,
+                                   to_tier=getattr(source, "retier_to",
+                                                   None))
         log.warning("req %d migrated %s -> %s (%s): %d token(s) shipped, "
                     "0 recomputed", flight.rid0, source.name, target.name,
                     why, n_gen)
@@ -1063,7 +1148,7 @@ class FleetRouter:
         flight.evac_since = None
         flight.member = None
         flight.attempt = None
-        target = self._choose_member(flight)
+        target = self._choose_member_timed(flight)
         if target is not None:
             self._dispatch(flight, target)
         else:
@@ -1384,7 +1469,10 @@ class FleetRouter:
             # stream must evacuate (recompute replay) right now.
             for flight in active:
                 if flight.evac_since is None and not flight.migrate_tried:
-                    out = self._try_migrate(flight, mem, why="drain")
+                    out = self._try_migrate(
+                        flight, mem,
+                        why=("retier" if mem.retier_to is not None
+                             else "drain"))
                     if out == "aborted":
                         self._begin_evac(flight)
                     # Only a hard outcome consumes the attempt; capacity
@@ -1416,6 +1504,87 @@ class FleetRouter:
                 for flight in active:
                     if flight.evac_since is None:
                         self._begin_evac(flight)
+
+    # ------------------------------------------------- fleet observability
+    def router_overhead_p99_ms(self) -> Optional[float]:
+        """Windowed p99 of the placement-decision overhead (ms) over the
+        last 512 placements; None before any placement. The health
+        monitor's overhead-storm alert and the bench fleet-chaos gate
+        both bound THIS number against --router-overhead-budget-ms."""
+        window = sorted(self._place_window)
+        if not window:
+            return None
+        return window[min(len(window) - 1, int(0.99 * len(window)))]
+
+    def router_overhead_stats(self) -> dict:
+        """Per-site overhead readout off the cumulative histogram plus
+        the windowed placement p99 (stats/TUI/bench surface)."""
+        sites = {}
+        for labelvalues, child in tm.ROUTER_OVERHEAD_MS.series():
+            if child.count == 0:
+                continue
+            sites[labelvalues[0]] = {
+                "count": child.count,
+                "mean_ms": round(child.sum / child.count, 4),
+                "p50_ms": round(child.quantile(0.5), 4),
+                "p99_ms": round(child.quantile(0.99), 4),
+            }
+        p99 = self.router_overhead_p99_ms()
+        return {
+            "sites": sites,
+            "place_p99_ms": round(p99, 4) if p99 is not None else None,
+            "budget_ms": getattr(self.ecfg, "router_overhead_budget_ms",
+                                 None),
+        }
+
+    def member_metric_federation(self) -> List[tuple]:
+        """(replica, registry snapshot) pairs for /metrics federation:
+        every HTTP member's scraped series re-exports with a `replica`
+        label next to the router's own. Ejected members drop out of the
+        exposition (their last snapshot is stale by definition);
+        LocalMembers share this process's registry and are already in
+        the local exposition."""
+        if not getattr(self.ecfg, "federate_metrics", True):
+            return []
+        out = []
+        for mem in self.members:
+            if mem.state == "ejected":
+                continue
+            snap = mem.metric_snapshot()
+            if snap:
+                out.append((mem.name, snap))
+        return out
+
+    def member_bundles(self) -> Dict[str, dict]:
+        """Per-member diagnostics for /debug/bundle, error-contained per
+        member: one dead replica must not cost the operator the rest of
+        the fleet's bundle."""
+        out: Dict[str, dict] = {}
+        for mem in self.members:
+            try:
+                out[mem.name] = mem.bundle()
+            except Exception as e:  # noqa: BLE001
+                out[mem.name] = {"error": f"{type(e).__name__}: {e}",
+                                 "state": mem.state}
+        return out
+
+    def fleet_trace_spans(self, rid: int) -> List[dict]:
+        """Every process's spans for the stream the client knows as
+        `rid`: the router's root trace (found by rid — stable across
+        failovers) plus each member's spans for the same fleet context.
+        GET /debug/trace/{rid} stitches these into one timeline whose
+        phase sum equals the client-observed e2e."""
+        root = self.tracer.find(rid)
+        if root is None:
+            return []
+        spans = self.tracer.export_spans([root])
+        ctx = root.ctx
+        for mem in self.members:
+            try:
+                spans.extend(mem.trace_spans(ctx))
+            except Exception:  # noqa: BLE001 — a dead member's spans
+                pass  # are simply absent; the root timeline stands
+        return spans
 
     # ----------------------------------------------------------------- stats
     def fleet_counts(self) -> dict:
@@ -1454,6 +1623,7 @@ class FleetRouter:
             "queued": self.core.total_queued(),
             "tiers": (self.tiers.status() if self.tiers is not None
                       else None),
+            "router_overhead": self.router_overhead_stats(),
         }
 
     def scheduler_stats(self) -> dict:

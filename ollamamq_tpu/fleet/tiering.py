@@ -275,6 +275,11 @@ class TierManager:
                 to_tier=member.tier, why=info["why"] or "no_capacity",
                 burn=info["burn"], replica=member.name,
                 queued=self.core.total_queued())
+            # Router-side span (tracing.ROUTER_EVENTS): the cross-tier
+            # decision reads straight off the stitched client timeline.
+            flight.req.trace_event("overflow", from_tier=tier,
+                                   to_tier=member.tier,
+                                   why=info["why"] or "no_capacity")
 
     def journal_failover_overflow(self, flight, member) -> None:
         """A failover/migration landed a stream cross-tier because its
@@ -290,6 +295,8 @@ class TierManager:
             "tier_overflow", req_id=flight.rid0, user=flight.user,
             model=flight.model or None, from_tier=tier,
             to_tier=member.tier, why="failover", replica=member.name)
+        flight.req.trace_event("overflow", from_tier=tier,
+                               to_tier=member.tier, why="failover")
 
     # ------------------------------------------------------------ balancing
     def _note_mix(self, tier: str) -> None:

@@ -134,7 +134,16 @@ class Server:
         # latency attribution, and the one-shot diagnostics bundle.
         r.add_route("GET", "/metrics", self.metrics)
         r.add_route("GET", "/metrics.json", self.metrics_json)
+        # Metrics federation wire: the raw registry snapshot a fleet
+        # router scrapes on its health heartbeat and re-exports with a
+        # `replica` label (mergeable JSON, same shape as the SPMD
+        # host-merge path).
+        r.add_route("GET", "/metrics/snapshot", self.metrics_snapshot)
         r.add_route("GET", "/debug/trace", self.debug_trace)
+        # Fleet-stitched single-stream trace: every process's spans for
+        # the stream the client knows as {rid}, merged into one Chrome
+        # trace-event timeline whose phase sum equals the client e2e.
+        r.add_route("GET", "/debug/trace/{req_id}", self.debug_trace_one)
         r.add_route("GET", "/debug/journal", self.debug_journal)
         r.add_route("GET", "/debug/requests", self.debug_requests)
         r.add_route("GET", "/debug/requests/{req_id}", self.debug_request)
@@ -202,13 +211,27 @@ class Server:
             raise ApiError(404, f"model '{name}' not found")
         return entry  # may be None: known architecture, not registered
 
+    @staticmethod
+    def _trace_ctx(request: web.Request):
+        """Propagated fleet trace context (`traceparent` header): the
+        fleet router stamps it on member requests so every process's
+        spans stitch under the client's stable rid; clients may supply
+        their own. None (the default) mints a fresh root context."""
+        from ollamamq_tpu.telemetry.tracing import (TRACEPARENT_HEADER,
+                                                    valid_ctx)
+
+        ctx = request.headers.get(TRACEPARENT_HEADER)
+        return ctx if ctx and valid_ctx(ctx) else None
+
     def _enqueue(self, user, ip, model, family, prompt_tokens, sampling,
                  kind="generate", raw_prompt="",
-                 context_ids=None) -> Request:
+                 context_ids=None, trace_ctx=None) -> Request:
         try:
             kw = {"kind": kind, "raw_prompt": raw_prompt}
             if context_ids:
                 kw["context_ids"] = context_ids
+            if trace_ctx:
+                kw["trace_ctx"] = trace_ctx
             return self.engine.enqueue_request(
                 user, ip, model, family, prompt_tokens, sampling, **kw,
             )
@@ -427,7 +450,27 @@ class Server:
             extra = eng.worker_metric_snapshots()
         except Exception:
             log.exception("worker metric snapshot fetch failed")
-        return REGISTRY.render(extra_snapshots=extra)
+        # Metrics federation (fleet router): every HTTP member's scraped
+        # snapshot re-exports with a `replica` label next to the
+        # router's own series — one Prometheus scrape sees the fleet.
+        federated = []
+        fed_fn = getattr(eng, "member_metric_federation", None)
+        if fed_fn is not None:
+            try:
+                federated = fed_fn()
+            except Exception:
+                log.exception("member metric federation failed")
+        return REGISTRY.render(extra_snapshots=extra, federated=federated)
+
+    async def metrics_snapshot(self, request: web.Request) -> web.Response:
+        """Raw registry snapshot (mergeable JSON): the federation wire a
+        fleet router scrapes on its member-health heartbeat."""
+        self._ident(request)
+        from ollamamq_tpu.telemetry import REGISTRY
+
+        snap = await asyncio.get_running_loop().run_in_executor(
+            None, REGISTRY.snapshot)
+        return web.json_response(snap)
 
     async def metrics_json(self, request: web.Request) -> web.Response:
         """The pre-Prometheus ad-hoc JSON payload (runtimes/chips/queue);
@@ -438,12 +481,58 @@ class Server:
     async def debug_trace(self, request: web.Request) -> web.Response:
         """Request-lifecycle traces as Chrome trace-event JSON: load in
         chrome://tracing or Perfetto to read a wedged/slow request off
-        its span timeline."""
+        its span timeline. `?ctx=<traceparent>` instead returns this
+        process's raw span export for that fleet trace context — the
+        stitching wire a fleet router reads to merge member spans under
+        the client's rid."""
         self._ident(request)
         tracer = getattr(self.engine, "tracer", None)
         if tracer is None:
             raise ApiError(501, "this engine does not trace requests")
+        ctx = request.query.get("ctx")
+        if ctx is not None:
+            from ollamamq_tpu.telemetry.tracing import valid_ctx
+
+            if not valid_ctx(ctx):
+                raise ApiError(400, "'ctx' must be a traceparent-shaped "
+                                    "trace context")
+            spans = tracer.export_spans(tracer.find_ctx(ctx))
+            return web.json_response({"ctx": ctx, "spans": spans})
         return web.json_response(tracer.export_chrome())
+
+    async def debug_trace_one(self, request: web.Request) -> web.Response:
+        """ONE stream's merged timeline, fleet-wide: the router's root
+        spans plus every member process's spans for the same fleet
+        context, stitched into a single Chrome trace-event JSON. The
+        `stitched` block carries the attribution invariant upgraded to
+        fleet level: phases_ms (handoffs included) sum to the
+        client-observed end-to-end wall clock."""
+        self._ident(request)
+        from ollamamq_tpu.telemetry import tracing
+
+        tracer = getattr(self.engine, "tracer", None)
+        if tracer is None:
+            raise ApiError(501, "this engine does not trace requests")
+        try:
+            rid = int(request.match_info["req_id"])
+        except ValueError:
+            raise ApiError(400, "request id must be an integer")
+        spans_fn = getattr(self.engine, "fleet_trace_spans", None)
+        loop = asyncio.get_running_loop()
+        if spans_fn is not None:
+            # Fleet router: member span fetches can ride real sockets —
+            # off the event loop.
+            spans = await loop.run_in_executor(None, spans_fn, rid)
+            root_origin = tracer.origin
+        else:
+            tr = tracer.find(rid)
+            spans = tracer.export_spans([tr]) if tr is not None else []
+            root_origin = tracer.origin
+        if not spans:
+            raise ApiError(404, f"no trace for request {rid} (expired "
+                                "from the ring, or never existed)")
+        return web.json_response(
+            tracing.merged_chrome(spans, root_origin=root_origin))
 
     async def debug_journal(self, request: web.Request) -> web.Response:
         """Flight-recorder ring tail: the engine's scheduler decision
@@ -504,20 +593,57 @@ class Server:
             rid = int(request.match_info["req_id"])
         except ValueError:
             raise ApiError(400, "request id must be an integer")
+        journal = getattr(self.engine, "journal", None)
         tr = tracer.find(rid)
         if tr is None:
+            # WAL-recovered stream, queried by its PRE-CRASH id: the
+            # tracer restarted empty, but the recovery pass journaled
+            # the old->new aliasing (recover_replay.wal_rid). Answer
+            # with the cross-link instead of a dead end — the post-crash
+            # timeline is one click away.
+            alias = self._recovered_as(journal, rid)
+            if alias is not None:
+                return web.json_response({
+                    "req_id": rid, "state": "recovered",
+                    "recovered_as": alias,
+                    "timeline": f"/debug/requests/{alias}",
+                    "note": ("this id predates a restart; the WAL "
+                             "recovery pass re-admitted the stream "
+                             f"as request {alias}")})
             raise ApiError(404, f"no trace for request {rid} (expired from "
                                 "the ring, or never existed)")
         from ollamamq_tpu.telemetry import attribution
 
         out = attribution.timeline(tr)
-        journal = getattr(self.engine, "journal", None)
         if journal is not None:
             # The request's slice of the decision journal: WHY it was
             # admitted/batched/preempted/shed, alongside WHERE its time
             # went (the phase timeline above).
             out["journal"] = journal.tail(n=100, req_id=rid)
+            # WAL cross-links, both directions: a recovered stream's new
+            # timeline names its pre-crash id (wal_rid), and a pre-crash
+            # id still in the ring names where it resumed.
+            for rec in journal.tail(None, kind="recover_replay"):
+                if rec.get("req_id") == rid \
+                        and rec.get("wal_rid") is not None:
+                    out["wal_rid"] = rec["wal_rid"]
+                    out["pre_crash_timeline"] = \
+                        f"/debug/requests/{rec['wal_rid']}"
+                elif rec.get("wal_rid") == rid:
+                    out["recovered_as"] = rec.get("req_id")
         return web.json_response(out)
+
+    @staticmethod
+    def _recovered_as(journal, rid: int):
+        """The post-recovery id a WAL'd pre-crash `rid` was re-admitted
+        under, off the journal's recover_replay records (None = no such
+        recovery in the ring)."""
+        if journal is None:
+            return None
+        for rec in journal.tail(None, kind="recover_replay"):
+            if rec.get("wal_rid") == rid:
+                return rec.get("req_id")
+        return None
 
     async def debug_bundle(self, request: web.Request) -> web.Response:
         """One-shot diagnostics bundle: config, metrics, request
@@ -551,6 +677,11 @@ class Server:
         section("config", lambda: _redact(dataclasses.asdict(eng.ecfg)))
         if hasattr(eng, "fleet_status"):
             section("fleet", eng.fleet_status)
+        if hasattr(eng, "member_bundles"):
+            # Fleet roll-up: each member's own bundle (HTTP members are
+            # fetched whole; local members read in-process), redacted
+            # like every other section and error-contained PER member.
+            section("members", lambda: _redact(eng.member_bundles()))
         section("env", lambda: _redact({
             k: v for k, v in os.environ.items()
             if k.startswith(("OLLAMAMQ_", "JAX_", "TPU_"))}))
@@ -733,10 +864,11 @@ class Server:
                 deadline = time.monotonic() + max(1.0, float(hdr)) / 1e3
             except ValueError:
                 raise ApiError(400, "X-Deadline-Ms must be a number")
+        trace_ctx = self._trace_ctx(request)
         try:
             req = await asyncio.get_running_loop().run_in_executor(
                 None, lambda: self.engine.import_stream(
-                    blob, ip=ip, deadline=deadline))
+                    blob, ip=ip, deadline=deadline, trace_ctx=trace_ctx))
         except MigrationError as e:
             raise ApiError(409, f"migration import failed: {e}")
         model = req.model or (blob.get("request") or {}).get("model", "")
@@ -840,7 +972,8 @@ class Server:
             raise ApiError(400, "'context' must be a list of token ids")
         req = self._enqueue(user, ip, model, Family.OLLAMA, tokens, sampling,
                             raw_prompt=prompt,
-                            context_ids=context or None)
+                            context_ids=context or None,
+                            trace_ctx=self._trace_ctx(request))
         if body.get("images"):
             req.images_ignored = True
 
@@ -867,7 +1000,8 @@ class Server:
         tokens = self._tokenize(model, prompt,
                                 add_bos=not template_owns_bos(chat_cfg))
         req = self._enqueue(user, ip, model, Family.OLLAMA, tokens, sampling,
-                            raw_prompt=prompt)
+                            raw_prompt=prompt,
+                            trace_ctx=self._trace_ctx(request))
         if any(isinstance(m, dict) and m.get("images") for m in messages):
             req.images_ignored = True
 
@@ -1214,7 +1348,8 @@ class Server:
         tokens = self._tokenize(model, prompt,
                                 add_bos=not template_owns_bos(chat_cfg))
         req = self._enqueue(user, ip, model, Family.OPENAI, tokens, sampling,
-                            raw_prompt=prompt)
+                            raw_prompt=prompt,
+                            trace_ctx=self._trace_ctx(request))
         if any(isinstance(p, dict) and p.get("type") == "image_url"
                for m in messages if isinstance(m, dict)
                for p in (m.get("content") if isinstance(m.get("content"),

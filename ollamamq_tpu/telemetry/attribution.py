@@ -59,6 +59,15 @@ EVENT_PHASE = {
     "preempt": "queue",
     "retry": "queue",
     "kv_stall": "decode",
+    # Fleet-router spans (tracing.ROUTER_EVENTS): a failover opens the
+    # recompute-replay wait (queue time until the re-dispatch lands); an
+    # overflow span is the cross-tier placement decision; a migration or
+    # regroup evacuation happens mid-decode — the stream keeps decoding
+    # on the target, so those spans stay in the decode phase.
+    "failover": "queue",
+    "overflow": "admission",
+    "migrate": "decode",
+    "regroup": "decode",
 }
 
 TERMINAL_EVENTS = ("stop", "length", "cancelled", "error",

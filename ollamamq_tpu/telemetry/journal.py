@@ -107,7 +107,10 @@ EVENT_FIELDS: Dict[str, Tuple[tuple, tuple]] = {
     # output length + effective (aged) score — the explainability
     # contract for "why did THIS request go first / lose its slot".
     "sched": (("policy", "point"), ("candidates", "score", "predicted")),
-    "place": (("runtime",), ()),
+    # `overhead_ms` (fleet router only) = the router's own placement-
+    # decision cost for THIS place, measured by the always-on
+    # perf_counter_ns timer that feeds ollamamq_router_overhead_ms.
+    "place": (("runtime",), ("overhead_ms",)),
     "shed": (("reason",),
              ("queued", "limit", "retry_after_s", "n_prompt", "max_tokens")),
     # `mode` tells the two batch shapes apart: "bucketed" records carry
@@ -171,11 +174,14 @@ EVENT_FIELDS: Dict[str, Tuple[tuple, tuple]] = {
     # generated = what recompute would have re-derived; pages/bytes =
     # what actually moved) and, router-side, the members involved.
     # `what` tells a stream handoff from a shipped prefix.
+    # `overhead_ms` (router-side records) = the router's measured cost
+    # of that handoff leg (export / import), per decision.
     "migrate_export": (("tokens",),
-                       ("replica", "kv_len", "pages", "bytes")),
+                       ("replica", "kv_len", "pages", "bytes",
+                        "overhead_ms")),
     "migrate_import": ((),
                        ("replica", "to_replica", "tokens", "pages",
-                        "bytes", "what")),
+                        "bytes", "what", "overhead_ms")),
     "migrate_abort": (("why",), ("replica", "to_replica")),
     # WAL records carry the durability cost (how long the admission
     # waited on its covering fsync) and the recovery inputs (how many

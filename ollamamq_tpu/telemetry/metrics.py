@@ -303,34 +303,82 @@ class MetricsRegistry:
             parts.append(extra)
         return "{" + ",".join(parts) + "}" if parts else ""
 
-    def render(self, extra_snapshots: Optional[List[dict]] = None) -> str:
+    def render(self, extra_snapshots: Optional[List[dict]] = None,
+               federated: Optional[List[Tuple[str, dict]]] = None) -> str:
         """Prometheus text exposition (format version 0.0.4). Peer-host
         snapshots merge in: counter/histogram series with identical
-        labels sum; gauge series union with local values winning."""
+        labels sum; gauge series union with local values winning.
+
+        `federated` is the fleet-router path: (replica_name, snapshot)
+        pairs whose every series re-exports UNLABELED-MERGED-NEVER —
+        each lands verbatim under the same family with an extra
+        `replica` label next to the router's own series, so ONE
+        Prometheus scrape of the router sees the whole fleet without
+        double counting (label sets may differ per sample within a
+        family; Prometheus accepts that)."""
         merged = self._merged_view(extra_snapshots or [])
+        fed = self._federated_view(federated or [])
         out: List[str] = []
-        for name in sorted(merged):
-            typ, help_, labelnames, buckets, series = merged[name]
+        for name in sorted(set(merged) | set(fed)):
+            local = merged.get(name)
+            fed_rows = fed.get(name, [])
+            typ, help_ = ((local[0], local[1]) if local is not None
+                          else (fed_rows[0][0], fed_rows[0][1]))
             out.append(f"# HELP {name} {help_}")
             out.append(f"# TYPE {name} {typ}")
-            for labelvalues in sorted(series):
-                val = series[labelvalues]
-                if typ == "histogram":
-                    counts, hsum, hcount = val
-                    cum = 0
-                    for i, ub in enumerate(list(buckets) + [math.inf]):
-                        cum += counts[i]
-                        ls = self._labels_str(
-                            labelnames, labelvalues,
-                            f'le="{format_float(ub)}"')
-                        out.append(f"{name}_bucket{ls} {cum}")
-                    ls = self._labels_str(labelnames, labelvalues)
-                    out.append(f"{name}_sum{ls} {format_float(hsum)}")
-                    out.append(f"{name}_count{ls} {hcount}")
-                else:
-                    ls = self._labels_str(labelnames, labelvalues)
-                    out.append(f"{name}{ls} {format_float(val)}")
+            if local is not None:
+                _, _, labelnames, buckets, series = local
+                for labelvalues in sorted(series):
+                    self._render_sample(out, name, typ, labelnames,
+                                        buckets, labelvalues,
+                                        series[labelvalues])
+            for ftyp, _fhelp, labelnames, buckets, series in fed_rows:
+                if ftyp != typ:
+                    continue  # cross-process type drift: local wins
+                for labelvalues in sorted(series):
+                    self._render_sample(out, name, typ, labelnames,
+                                        buckets, labelvalues,
+                                        series[labelvalues])
         return "\n".join(out) + "\n"
+
+    def _render_sample(self, out: List[str], name, typ, labelnames,
+                       buckets, labelvalues, val) -> None:
+        if typ == "histogram":
+            counts, hsum, hcount = val
+            cum = 0
+            for i, ub in enumerate(list(buckets) + [math.inf]):
+                cum += counts[i] if i < len(counts) else 0
+                ls = self._labels_str(
+                    labelnames, labelvalues, f'le="{format_float(ub)}"')
+                out.append(f"{name}_bucket{ls} {cum}")
+            ls = self._labels_str(labelnames, labelvalues)
+            out.append(f"{name}_sum{ls} {format_float(hsum)}")
+            out.append(f"{name}_count{ls} {hcount}")
+        else:
+            ls = self._labels_str(labelnames, labelvalues)
+            out.append(f"{name}{ls} {format_float(val)}")
+
+    @staticmethod
+    def _federated_view(federated: List[Tuple[str, dict]]) -> dict:
+        """name -> [(type, help, labelnames+('replica',), buckets,
+        {labelvalues+(replica,): value})] rows, one per (replica,
+        metric). Malformed member snapshots are skipped, never fail the
+        scrape."""
+        view: dict = {}
+        for replica, snap in federated:
+            for name, rec in (snap or {}).items():
+                try:
+                    typ = rec["type"]
+                    labelnames = tuple(rec["labels"]) + ("replica",)
+                    buckets = tuple(rec.get("buckets", ()))
+                    series = {tuple(lv) + (str(replica),): v
+                              for lv, v in rec["series"]}
+                except (KeyError, TypeError):
+                    continue
+                view.setdefault(name, []).append(
+                    (typ, rec.get("help", ""), labelnames, buckets,
+                     series))
+        return view
 
     def _merged_view(self, extras: List[dict]) -> dict:
         view: dict = {}

@@ -222,6 +222,25 @@ QUANT_LOGIT_ERR = REGISTRY.gauge(
     labels=("model",))
 
 # -- fleet router (fleet/router.py; dispatcher-over-engines) ---------------
+# Closed site vocabulary for ollamamq_router_overhead_ms{site}: every
+# always-on nanosecond timer around the router hot path. "place" is the
+# bounded one (the bench fleet-chaos gate fails when its p99 exceeds
+# --router-overhead-budget-ms); the rest attribute where the router's
+# own time goes per decision.
+ROUTER_OVERHEAD_SITES = ("place", "journal", "wal_fsync",
+                         "migrate_export", "migrate_ship",
+                         "migrate_import")
+ROUTER_OVERHEAD_MS = REGISTRY.histogram(
+    "ollamamq_router_overhead_ms",
+    "Router hot-path self-profiling: milliseconds the router itself "
+    "spent per decision, by site (place = the placement decision, "
+    "journal = one flight-recorder append, wal_fsync = the durable-"
+    "admission gate, migrate_export/_ship/_import = the three legs of "
+    "a KV handoff) — always-on perf_counter_ns timers, the measured "
+    "and bounded 'router overhead' of the fleet-scale story",
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+             25.0, 50.0, 100.0, 250.0, 1000.0),
+    labels=("site",))
 FLEET_REPLICAS = REGISTRY.gauge(
     "ollamamq_fleet_replicas",
     "Engine replicas under the fleet router by state (healthy / ejected "

@@ -8,6 +8,14 @@ by `record`):
     tail FILE      raw records (filters: --n/--req-id/--user/--kind)
     explain FILE   per-decision human explanations (same filters)
     stats FILE     batch occupancy + padding-waste + fair-share audit
+    merge FILE...  interleave multiple fleet spills (router + members)
+                   into ONE arrival-normalized timeline (--out FILE,
+                   default stdout): records sort on their shared
+                   monotonic clock, re-sequence, carry src/src_seq/
+                   src_tick provenance, and get a rebased virtual tick
+                   (the PR-11 gap-capped normalization) — so tail/
+                   explain/stats run FLEET-WIDE over the merged file,
+                   the live-journal roll-up next to `check`'s audit
     check FILE...  invariant checker (exit 1 on any violation); fleet
                    journals additionally pin zero-drop: every stream a
                    replica_eject/replica_failover touched must reach a
@@ -310,6 +318,56 @@ def normalize_arrival_ticks(arrivals: List[dict]) -> List[dict]:
     return out
 
 
+# One merged virtual tick per this many seconds of wall-clock gap when
+# interleaving fleet spills: per-process `tick` counters advance at
+# each process's own loop rate, so the merged axis derives from the
+# shared monotonic clock instead (≈ the router's 20ms idle wait per
+# tick), with idle gaps capped like the PR-11 arrival normalization.
+MERGE_TICK_S = 0.02
+
+
+def merge_journals(paths: List[str]) -> Tuple[dict, List[dict]]:
+    """Interleave several spilled journals (one fleet run's router +
+    member files) into ONE timeline: records sort on their recorded
+    monotonic `t` (CLOCK_MONOTONIC is system-wide on Linux, so spills
+    from co-located processes share the axis; cross-host skew shows as
+    interleave error, never record loss), re-sequence from 0, keep
+    provenance (`src` = source file, `src_seq`/`src_tick` = original
+    coordinates), and rebase `tick` onto one arrival-normalized virtual
+    axis (gaps capped at MAX_ARRIVAL_GAP_TICKS). The result loads like
+    any spill: tail/explain/stats consume it fleet-wide."""
+    import os as _os
+
+    rows = []
+    sources = []
+    for path in paths:
+        meta, records = load_jsonl(path)
+        src = _os.path.basename(path)
+        src_meta = {"file": src, "records": len(records)}
+        if meta.get("sample") is not None:
+            src_meta["sample"] = meta["sample"]
+        sources.append(src_meta)
+        for r in records:
+            rows.append((float(r.get("t") or 0.0), src, r))
+    rows.sort(key=lambda x: x[0])  # stable: equal t keeps per-file order
+    merged: List[dict] = []
+    vtick = 0
+    prev_t: Optional[float] = None
+    for seq, (t, src, r) in enumerate(rows):
+        if prev_t is not None and t > prev_t:
+            vtick += min(MAX_ARRIVAL_GAP_TICKS,
+                         int((t - prev_t) / MERGE_TICK_S))
+        prev_t = t
+        rec = dict(r)
+        rec["src"] = src
+        rec["src_seq"] = r.get("seq")
+        rec["src_tick"] = r.get("tick")
+        rec["seq"] = seq
+        rec["tick"] = vtick
+        merged.append(rec)
+    return {"version": 1, "merged_from": sources}, merged
+
+
 def drive_chaos(arrivals: List[dict], fault_plan: dict, engine: dict,
                 journal: Journal):
     """Synchronously drive a FakeRuntime engine through the arrival
@@ -530,7 +588,25 @@ def _cmd_tail(args) -> int:
 def _cmd_explain(args) -> int:
     _meta, records = load_jsonl(args.file)
     for r in _filtered(records, args):
-        print(f"[{r.get('seq', '?'):>6} t{r.get('tick', '?')}] {explain(r)}")
+        src = f" {r['src']}" if r.get("src") else ""  # merged spills
+        print(f"[{r.get('seq', '?'):>6} t{r.get('tick', '?')}{src}] "
+              f"{explain(r)}")
+    return 0
+
+
+def _cmd_merge(args) -> int:
+    meta, merged = merge_journals(args.file)
+    lines = [json.dumps({"journal_meta": meta})]
+    lines += [json.dumps(r, default=str) for r in merged]
+    if args.out and args.out != "-":
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write("\n".join(lines) + "\n")
+        srcs = ", ".join(s["file"] for s in meta["merged_from"])
+        print(f"merged {len(merged)} records from {len(args.file)} "
+              f"spill(s) ({srcs}) -> {args.out}")
+    else:
+        for line in lines:
+            print(line)
     return 0
 
 
@@ -736,6 +812,16 @@ def build_parser() -> argparse.ArgumentParser:
                          "fleet-wide roll-up (router + member spills "
                          "audited as one run)")
     sp.set_defaults(fn=_cmd_check)
+    sp = sub.add_parser("merge")
+    sp.add_argument("file", nargs="+",
+                    help="two or more spilled journals of ONE fleet run "
+                         "(router + members) to interleave into a "
+                         "single arrival-normalized timeline")
+    sp.add_argument("--out", default="-",
+                    help="merged JSONL destination ('-' = stdout); "
+                         "tail/explain/stats then run fleet-wide over "
+                         "it")
+    sp.set_defaults(fn=_cmd_merge)
     sp = sub.add_parser("record")
     sp.add_argument("file")
     sp.add_argument("--seed", type=int, default=0)

@@ -18,11 +18,18 @@
      recorder" table (between the `<!-- journal-events:begin -->` /
      `<!-- journal-events:end -->` markers) exactly: an event kind the
      engine can record but the table doesn't document is a drift
-     failure, and so is a documented kind the journal no longer emits.
+     failure, and so is a documented kind the journal no longer emits;
+  5. router spans — the fleet router's closed trace-span vocabulary
+     (telemetry/tracing.py ROUTER_EVENTS: the event names the router
+     drops into request traces, stitched fleet-wide at
+     GET /debug/trace/{rid}) must match the README router-span table
+     (between the `<!-- router-spans:begin -->` /
+     `<!-- router-spans:end -->` markers) exactly — same pattern as
+     phases.
 
-Imports ONLY ollamamq_tpu.telemetry.schema/.attribution/.journal — the
-declaration sites — so the check runs without jax, a device, or an
-engine. Wired into tier-1 via tests/test_metrics_docs.py.
+Imports ONLY ollamamq_tpu.telemetry.schema/.attribution/.journal/
+.tracing — the declaration sites — so the check runs without jax, a
+device, or an engine. Wired into tier-1 via tests/test_metrics_docs.py.
 
 Usage: python scripts/check_metrics_docs.py [README.md]
 Exit 0 = consistent; 1 = drift (names printed); 2 = usage error.
@@ -42,6 +49,8 @@ SHED_BEGIN = "<!-- shed-reasons:begin -->"
 SHED_END = "<!-- shed-reasons:end -->"
 JOURNAL_BEGIN = "<!-- journal-events:begin -->"
 JOURNAL_END = "<!-- journal-events:end -->"
+ROUTER_SPANS_BEGIN = "<!-- router-spans:begin -->"
+ROUTER_SPANS_END = "<!-- router-spans:end -->"
 
 
 def documented_metric_names(readme_text: str) -> set:
@@ -109,6 +118,22 @@ def registered_journal_events() -> set:
     return set(EVENTS)
 
 
+def documented_router_spans(readme_text: str) -> set:
+    """Backticked names inside the marked router-span region."""
+    start = readme_text.find(ROUTER_SPANS_BEGIN)
+    end = readme_text.find(ROUTER_SPANS_END)
+    if start == -1 or end == -1 or end < start:
+        return set()
+    return set(re.findall(r"`([a-z_]+)`", readme_text[start:end]))
+
+
+def registered_router_spans() -> set:
+    sys.path.insert(0, _REPO)
+    from ollamamq_tpu.telemetry.tracing import ROUTER_EVENTS
+
+    return set(ROUTER_EVENTS)
+
+
 def _diff(readme: str, what: str, registered: set, documented: set,
           missing_msg: str, ghost_msg: str) -> int:
     rc = 0
@@ -158,11 +183,18 @@ def main(argv) -> int:
         "journal event kind(s) missing from the README flight-recorder "
         f"table (between {JOURNAL_BEGIN} / {JOURNAL_END})",
         "documented journal event kind(s) the engine no longer records")
+    rc |= _diff(
+        readme, "router spans", registered_router_spans(),
+        documented_router_spans(text),
+        "router trace-span name(s) missing from the README router-span "
+        f"table (between {ROUTER_SPANS_BEGIN} / {ROUTER_SPANS_END})",
+        "documented router span(s) the router no longer emits")
     if rc == 0:
         print(f"ok: {len(registered_metric_names())} metrics, "
               f"{len(registered_phase_names())} phases, "
-              f"{len(registered_shed_reasons())} shed reasons, and "
-              f"{len(registered_journal_events())} journal events, "
+              f"{len(registered_shed_reasons())} shed reasons, "
+              f"{len(registered_journal_events())} journal events, and "
+              f"{len(registered_router_spans())} router spans, "
               "all documented")
     return rc
 

@@ -1,0 +1,446 @@
+"""Fleet observability plane: distributed tracing (one stitched
+timeline per stream across router + member processes), metrics
+federation (member series re-exported with a replica label), and the
+router-overhead self-profiler (placement p99 measured and bounded).
+
+The contract under test: a stream that crossed processes — placed by
+the router, served by an HTTP member, failed over to a second member —
+still reads as ONE timeline at /debug/trace/{rid}, whose fleet-wide
+phase sum equals the client-observed end-to-end wall clock.
+"""
+
+import asyncio
+import time
+
+from ollamamq_tpu.config import EngineConfig
+from ollamamq_tpu.engine.fake import FakeEngine
+from ollamamq_tpu.fleet import FleetRouter, HttpMember
+from ollamamq_tpu.telemetry import REGISTRY
+from ollamamq_tpu.telemetry import schema as tm
+from ollamamq_tpu.telemetry import tracing
+from test_fleet import TINY, _fake_fleet, _HttpBackend, _run, _text
+from testutil import collect
+
+TOL_MS = 0.5  # float noise on phase-sum == e2e (ms)
+
+
+def _place_count() -> int:
+    child = tm.ROUTER_OVERHEAD_MS.labels(site="place")
+    return child.count
+
+
+# ------------------------------------------------------------ trace context
+def test_ctx_mint_and_validate():
+    ctx = tracing.mint_ctx()
+    assert tracing.valid_ctx(ctx)
+    assert not tracing.valid_ctx("nope")
+    assert not tracing.valid_ctx(None)
+    assert not tracing.valid_ctx("00-xyz-abc-01")
+
+
+def test_trace_ctx_propagates_in_process_and_stitches():
+    """LocalMember fleet: the member-side attempt traces under the
+    router's fleet context (un-metered), and the merged timeline's
+    phase sum equals the router-observed e2e."""
+    router = _fake_fleet(n=2, token_latency_s=0.01)
+    try:
+        req = _run(router, "tr-local", "trace me please", max_tokens=6)
+        rid = req.req_id
+        items = collect(req)
+        assert items[-1].kind == "done"
+        root = router.tracer.find(rid)
+        assert root is not None and tracing.valid_ctx(root.ctx)
+        # The member engine holds a span under the SAME ctx, origin'd
+        # with the member name.
+        member_spans = []
+        for mem in router.members:
+            member_spans += mem.trace_spans(root.ctx)
+        assert member_spans, "no member-side spans for the fleet ctx"
+        assert all(s["origin"] in ("r0", "r1") for s in member_spans)
+        # Member traces never meter the shared registry (the router's
+        # root trace already did).
+        for mem in router.members:
+            for tr in mem.engine.tracer.find_ctx(root.ctx):
+                assert tr.metered is False
+        # Stitched timeline: phase sum == client-observed e2e.
+        spans = router.fleet_trace_spans(rid)
+        assert {s["origin"] for s in spans} >= {"router"}
+        merged = tracing.merged_chrome(spans, root_origin="router")
+        st = merged["stitched"]
+        assert st["outcome"] in ("stop", "length")
+        assert st["e2e_ms"] > 0
+        assert abs(st["phase_sum_ms"] - st["e2e_ms"]) < TOL_MS
+        assert "router" in st["origins"]
+        # Decode happened member-side: the stitched breakdown must see
+        # member spans, not just router bookkeeping.
+        assert st["phases_ms"].get("decode", 0) > 0
+    finally:
+        router.stop()
+
+
+def test_debug_trace_rid_http_and_failover_keeps_trace_whole():
+    """ACCEPTANCE: a greedy stream placed by the router, failed over
+    mid-decode to a second real HTTP member, shows ONE merged trace at
+    /debug/trace/{rid} whose fleet-wide phase sum equals the
+    client-observed e2e wall clock."""
+    member_cfg = EngineConfig(**TINY)
+    backends = [
+        _HttpBackend(FakeEngine(member_cfg, blocklist_path=None,
+                                token_latency_s=0.05))
+        for _ in range(2)
+    ]
+    for b in backends:
+        b.engine.start()
+    ecfg = EngineConfig(**TINY)
+    members = [HttpMember(f"h{i}", b.url, timeout_s=30, poll_period_s=0.1)
+               for i, b in enumerate(backends)]
+    router = FleetRouter(members, ecfg, blocklist_path=None,
+                         probe_period_s=0.05, eject_heartbeat_s=1.0,
+                         reprobe_backoff_s=0.2, evac_grace_s=0.5)
+    router.start()
+    try:
+        t0 = time.monotonic()
+        req = _run(router, "tr-kill", "trace the victim", max_tokens=16)
+        rid = req.req_id
+        # Kill the serving backend once the stream is mid-decode.
+        deadline = time.monotonic() + 30
+        victim = None
+        while time.monotonic() < deadline:
+            f = next((f for f in list(router.flights) if f.req is req),
+                     None)
+            if f is not None and f.attempt is not None \
+                    and f.attempt.n_items >= 2:
+                victim = f.member
+                break
+            time.sleep(0.01)
+        assert victim is not None
+        backends[int(victim.name[1])].stop()
+        items = collect(req, timeout=60)
+        e2e_observed_ms = (time.monotonic() - t0) * 1e3
+        assert items[-1].kind == "done"
+        assert _text(items) == "".join(f"word{i} " for i in range(16))
+        assert router.failover_count >= 1
+
+        # Spans from the ROUTER process and the SURVIVING member
+        # process (fetched over real HTTP /debug/trace?ctx=...)
+        # stitch into one timeline.
+        spans = router.fleet_trace_spans(rid)
+        origins = {s["origin"] for s in spans}
+        survivor = f"h{1 - int(victim.name[1])}"
+        assert "router" in origins
+        assert survivor in origins, f"no spans from {survivor}: {origins}"
+        merged = tracing.merged_chrome(spans, root_origin="router")
+        st = merged["stitched"]
+        assert st["outcome"] in ("stop", "length")
+        assert abs(st["phase_sum_ms"] - st["e2e_ms"]) < TOL_MS
+        # The merged e2e is the client-observed wall clock (bounded by
+        # what this test measured around the stream).
+        assert st["e2e_ms"] <= e2e_observed_ms + TOL_MS
+        names = [e["name"] for e in st["events"]]
+        assert "failover" in names or "migrate" in names
+        assert "first_token" in names
+        # One row per origin in the Chrome export.
+        tids = {e["tid"] for e in merged["traceEvents"]}
+        assert len(tids) >= 2
+    finally:
+        router.stop()
+        for b in backends:
+            b.stop()
+
+
+def test_traceparent_header_adopted_by_member_server():
+    """The member-side HTTP server adopts a propagated traceparent: the
+    wire contract HttpMember relies on for stitching."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from ollamamq_tpu.server.app import Server
+
+    eng = FakeEngine(EngineConfig(**TINY), blocklist_path=None)
+    eng.start()
+    ctx = tracing.mint_ctx()
+
+    async def main():
+        server = Server(eng, timeout_s=30)
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            resp = await client.post(
+                "/api/generate",
+                json={"model": "test-tiny", "prompt": "hello",
+                      "stream": False, "options": {"num_predict": 3}},
+                headers={tracing.TRACEPARENT_HEADER: ctx})
+            assert resp.status == 200
+            await resp.json()
+            # The raw span export for the ctx (the stitching wire).
+            resp = await client.get(f"/debug/trace?ctx={ctx}")
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["ctx"] == ctx
+            assert len(body["spans"]) == 1
+            assert body["spans"][0]["ctx"] == ctx
+            # Junk ctx is a client error, not an empty result.
+            resp = await client.get("/debug/trace?ctx=garbage")
+            assert resp.status == 400
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(main())
+    eng.stop()
+
+
+# --------------------------------------------------------------- federation
+def _wait(cond, budget=30.0, msg="condition"):
+    deadline = time.monotonic() + budget
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"timed out waiting for {msg}")
+
+
+def test_metrics_federation_replica_labels_under_eject_rejoin():
+    """Member series re-export with a replica label; an ejected member
+    drops out of the exposition and returns on rejoin."""
+    member_cfg = EngineConfig(**TINY)
+    backends = [
+        _HttpBackend(FakeEngine(member_cfg, blocklist_path=None))
+        for _ in range(2)
+    ]
+    for b in backends:
+        b.engine.start()
+    ecfg = EngineConfig(**TINY)
+    members = [HttpMember(f"h{i}", b.url, timeout_s=30, poll_period_s=0.1)
+               for i, b in enumerate(backends)]
+    router = FleetRouter(members, ecfg, blocklist_path=None,
+                         probe_period_s=0.05, eject_heartbeat_s=1.0,
+                         reprobe_backoff_s=0.1, evac_grace_s=0.5)
+    router.start()
+    try:
+        _wait(lambda: all(m.metric_snapshot() for m in members),
+              msg="member metric snapshots")
+        fed = router.member_metric_federation()
+        assert {name for name, _ in fed} == {"h0", "h1"}
+        text = REGISTRY.render(federated=fed)
+        assert 'replica="h0"' in text
+        assert 'replica="h1"' in text
+        # The replica label lands on real member series, inside the
+        # same family as the router's own (ONE HELP/TYPE block per
+        # family even when local + federated series coexist).
+        import re as _re
+
+        m = _re.search(r'^(ollamamq_[a-z0-9_]+?)(?:_bucket|_sum|_count)?'
+                       r'\{[^}]*replica="h0"', text, _re.M)
+        assert m, "no federated series found"
+        fam = m.group(1)
+        assert text.count(f"# TYPE {fam} ") == 1
+
+        # Eject h0: its series must leave the exposition.
+        members[0].crash()
+        _wait(lambda: members[0].state == "ejected", msg="h0 eject")
+        text = REGISTRY.render(federated=router.member_metric_federation())
+        assert 'replica="h0"' not in text
+        assert 'replica="h1"' in text
+
+        # Heal: the re-probe rejoins it and its series return.
+        _wait(lambda: members[0].state == "healthy", budget=60,
+              msg="h0 rejoin")
+        _wait(lambda: any(n == "h0" for n, _ in
+                          router.member_metric_federation()),
+              msg="h0 snapshot back")
+        text = REGISTRY.render(federated=router.member_metric_federation())
+        assert 'replica="h0"' in text
+    finally:
+        router.stop()
+        for b in backends:
+            b.stop()
+
+
+def test_federation_off_switch():
+    router = _fake_fleet(n=2)
+    try:
+        router.ecfg.federate_metrics = False
+        assert router.member_metric_federation() == []
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------- router overhead
+def test_router_overhead_histogram_journal_and_alert():
+    """Every placement lands in ollamamq_router_overhead_ms{site=place}
+    AND on the place journal record; the windowed p99 feeds stats and
+    the health monitor's overhead-storm alert (fires over budget,
+    resolves under it)."""
+    before = _place_count()
+    router = _fake_fleet(n=2)
+    try:
+        reqs = [_run(router, f"ov{i}", max_tokens=4) for i in range(4)]
+        for r in reqs:
+            collect(r)
+        assert _place_count() > before
+        places = router.journal.tail(None, kind="place")
+        assert places and any(p.get("overhead_ms") is not None
+                              for p in places)
+        p99 = router.router_overhead_p99_ms()
+        assert p99 is not None and p99 >= 0
+        stats = router.stats()["fleet"]["router_overhead"]
+        assert stats["sites"]["place"]["count"] > 0
+        assert stats["place_p99_ms"] is not None
+        assert stats["budget_ms"] == router.ecfg.router_overhead_budget_ms
+        # Journal self-timer: every router journal append is measured.
+        jsite = tm.ROUTER_OVERHEAD_MS.labels(site="journal")
+        assert jsite.count > 0
+
+        # Overhead-storm alert: impossible budget -> fires; sane
+        # budget -> resolves. (check_once also probes the device; CPU.)
+        router.ecfg.router_overhead_budget_ms = 1e-9
+        router.health.check_once()
+        assert any(a.name == "router_overhead"
+                   for a in router.alerts.active())
+        router.ecfg.router_overhead_budget_ms = 1e9
+        router.health.check_once()
+        assert not any(a.name == "router_overhead"
+                       for a in router.alerts.active())
+    finally:
+        router.stop()
+
+
+# ------------------------------------------------- /debug endpoints + WAL
+def test_debug_trace_rid_and_wal_cross_links_over_http():
+    """Single-engine /debug/trace/{rid} (degenerate stitch) plus the
+    satellite bugfix: /debug/requests cross-links wal_rid in BOTH
+    directions so a recovered stream's pre-crash timeline is one click
+    away instead of a 404 dead end."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from ollamamq_tpu.server.app import Server
+
+    eng = FakeEngine(EngineConfig(**TINY), blocklist_path=None)
+    eng.start()
+
+    async def main():
+        server = Server(eng, timeout_s=30)
+        client = TestClient(TestServer(server.build_app()))
+        await client.start_server()
+        try:
+            req = _run(eng, "walx", "cross link me", max_tokens=4)
+            rid = req.req_id
+            collect(req)
+            resp = await client.get(f"/debug/trace/{rid}")
+            assert resp.status == 200
+            merged = await resp.json()
+            st = merged["stitched"]
+            assert abs(st["phase_sum_ms"] - st["e2e_ms"]) < TOL_MS
+            resp = await client.get("/debug/trace/999999")
+            assert resp.status == 404
+
+            # Simulate a WAL recovery's aliasing record: old id 999001
+            # was re-admitted as `rid`.
+            old = 999001
+            eng.journal.record("recover_replay", req_id=rid, user="walx",
+                              tokens=2, outcome="replayed", wal_rid=old)
+            resp = await client.get(f"/debug/requests/{rid}")
+            body = await resp.json()
+            assert body["wal_rid"] == old
+            assert body["pre_crash_timeline"] == f"/debug/requests/{old}"
+            # The pre-crash id has NO trace (tracer restarted empty in a
+            # real crash) — the endpoint answers the cross-link, not 404.
+            resp = await client.get(f"/debug/requests/{old}")
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["state"] == "recovered"
+            assert body["recovered_as"] == rid
+            # A genuinely unknown id still 404s.
+            resp = await client.get("/debug/requests/424242")
+            assert resp.status == 404
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(main())
+    eng.stop()
+
+
+def test_router_debug_bundle_gathers_member_sections():
+    router = _fake_fleet(n=2)
+    try:
+        req = _run(router, "bun", max_tokens=3)
+        collect(req)
+        from ollamamq_tpu.server.app import Server
+
+        bundle = Server(router, timeout_s=30)._build_bundle()
+        assert set(bundle["members"]) == {"r0", "r1"}
+        for row in bundle["members"].values():
+            assert row.get("kind") == "local"
+            assert "stats" in row and "journal" in row
+        assert "router_overhead" in bundle["fleet"]
+    finally:
+        router.stop()
+
+
+# ------------------------------------------------------------ journal merge
+def test_journal_merge_interleaves_fleet_spills(tmp_path):
+    from ollamamq_tpu.telemetry.journal import Journal, load_jsonl
+    from ollamamq_tpu.tools import journal as tools
+
+    ra, rb = str(tmp_path / "router.jsonl"), str(tmp_path / "member.jsonl")
+    ja = Journal(capacity=64, path=ra)
+    jb = Journal(capacity=64, path=rb)
+    # Interleave writes so merged order must come from `t`, not file
+    # order; a dead gap in the middle exercises the tick cap.
+    ja.record("enqueue", req_id=1, user="u", n_prompt=4, queued=1)
+    jb.record("install", req_id=101, user="u", slot=0)
+    ja.record("admit", req_id=1, user="u", queued=0)
+    time.sleep(1.2)  # >> MERGE_TICK_S * MAX_ARRIVAL_GAP_TICKS
+    jb.record("finish", req_id=101, user="u", reason="stop", tokens=2)
+    ja.record("finish", req_id=1, user="u", reason="stop", tokens=2)
+    ja.close()
+    jb.close()
+
+    meta, merged = tools.merge_journals([ra, rb])
+    assert [s["file"] for s in meta["merged_from"]] == ["router.jsonl",
+                                                        "member.jsonl"]
+    assert [r["seq"] for r in merged] == list(range(5))
+    ts = [r["t"] for r in merged]
+    assert ts == sorted(ts)
+    assert {r["src"] for r in merged} == {"router.jsonl", "member.jsonl"}
+    assert all("src_seq" in r and "src_tick" in r for r in merged)
+    ticks = [r["tick"] for r in merged]
+    assert ticks == sorted(ticks)
+    # The 1.2s dead gap is capped at MAX_ARRIVAL_GAP_TICKS virtual ticks.
+    assert max(ticks) <= tools.MAX_ARRIVAL_GAP_TICKS + 4
+
+    # CLI roundtrip: merge --out, then tail/explain/stats consume the
+    # merged file fleet-wide.
+    out = str(tmp_path / "merged.jsonl")
+    assert tools.main(["merge", "--out", out, ra, rb]) == 0
+    m2, recs = load_jsonl(out)
+    assert len(recs) == 5 and m2["merged_from"][0]["file"] == "router.jsonl"
+    assert tools.main(["tail", out, "--kind", "finish", "--n", "0"]) == 0
+    assert tools.main(["explain", out]) == 0
+    assert tools.main(["stats", out]) == 0
+
+
+# ------------------------------------------------------------ doc gate
+def test_router_span_vocabulary_is_doc_gated(tmp_path):
+    """Gate 5: the router span table and tracing.ROUTER_EVENTS must not
+    drift (missing row and ghost row both fail)."""
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "check_metrics_docs",
+        os.path.join(repo, "scripts", "check_metrics_docs.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    with open(os.path.join(repo, "README.md"), encoding="utf-8") as f:
+        full = f.read()
+    assert mod.main(["check_metrics_docs.py"]) == 0
+    missing = tmp_path / "README_nospan.md"
+    missing.write_text(full.replace("| `failover` |", "| failover-less |",
+                                    1))
+    assert mod.main(["check_metrics_docs.py", str(missing)]) == 1
+    ghost = tmp_path / "README_ghostspan.md"
+    ghost.write_text(full.replace(
+        mod.ROUTER_SPANS_END,
+        "| `notaspan` | bogus |\n" + mod.ROUTER_SPANS_END, 1))
+    assert mod.main(["check_metrics_docs.py", str(ghost)]) == 1

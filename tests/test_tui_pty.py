@@ -292,6 +292,37 @@ def test_tui_tiers_line_via_pty(tmp_path):
         t.close()
 
 
+# Engine stub shaped like a fleet router with the overhead self-profiler:
+# the replicas line must carry the `router p99` chip (the windowed
+# placement-decision p99 the health monitor bounds against the budget).
+_CHILD_OVERHEAD = _CHILD.replace(
+    'eng.runtimes = {}\nadmin_tui.run_tui(eng, None, refresh_ms=50)',
+    '''eng.runtimes = {}
+class _Ecfg:
+    router_overhead_budget_ms = 50.0
+eng.ecfg = _Ecfg()
+eng.router_overhead_p99_ms = lambda: 3.21
+eng.fleet_counts = lambda: {"healthy": 2, "ejected": 0, "draining": 0}
+admin_tui.run_tui(eng, None, refresh_ms=50)''')
+assert _CHILD_OVERHEAD != _CHILD, "overhead child patch failed to apply"
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="pty/termios test")
+def test_tui_router_overhead_chip_via_pty(tmp_path):
+    """Fleet-router TUI: the replicas line carries the router-overhead
+    chip (windowed placement p99 in ms) in the rendered frames; red-
+    over-budget is the C++ side's `over` flag, asserted on content."""
+    t = _PtyTui(tmp_path, child_src=_CHILD_OVERHEAD)
+    try:
+        assert t.wait_output(b"replicas 2 healthy"), _stderr(t)
+        assert t.wait_output(b"router p99 3.21ms"), _stderr(t)
+        t.send("q")
+        assert t.wait_output(b"TUI_EXIT_OK"), _stderr(t)
+        assert t.proc.wait(timeout=30) == 0
+    finally:
+        t.close()
+
+
 @pytest.mark.skipif(sys.platform != "linux", reason="pty/termios test")
 def test_tui_no_alerts_renders_quiet_panel(tmp_path):
     """Without an alert table (or with it empty) the ALERTS section still
