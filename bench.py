@@ -55,7 +55,7 @@ def _fallback_argv(model: str, dtypes=("bfloat16", "bfloat16"),
            "--shared-prefix-tail", "16",
            "--slo-burst", "2", "--slo-burst-size", "4",
            "--overload", "16", "--density", "8", "--scheduling", "16",
-           "--tiering", "16",
+           "--tiering", "16", "--diurnal", "8",
            "--init-timeout", "300"]
 
 
@@ -283,6 +283,21 @@ def main() -> int:
                         "journal audit; pass gate: tiered <= the "
                         "latency-viable homogeneous fleet on p99 "
                         "interactive TTFT AND >= on aggregate tok/s")
+    p.add_argument("--diurnal", type=int, default=24,
+                   help="interactive requests across the diurnal "
+                        "scenario's compressed day (0 disables): a "
+                        "night-day-night sinusoidal + bursty trace "
+                        "through an ELASTIC tiered fleet (--autoscale: "
+                        "burn/backlog scale-up, drain-based scale-down, "
+                        "a mid-day preemption notice, and a bulk "
+                        "scale-to-zero + wake cycle) and through a "
+                        "FIXED fleet at the elastic leg's peak size — "
+                        "p99 interactive TTFT, member-hours, scale "
+                        "events, 0 drops / 0 silent truncations, and "
+                        "the multi-spill journal audit incl. scale "
+                        "pairing; pass gate: elastic within tolerance "
+                        "of fixed on p99 TTFT at strictly fewer "
+                        "member-hours")
     p.add_argument("--crash-restart", type=int, default=8,
                    help="streams in the crash_restart scenario: real "
                         "server subprocesses (router + two HTTP member "
@@ -823,6 +838,21 @@ def main() -> int:
             print(f"# tiering scenario failed: {tiering['error']}",
                   file=sys.stderr)
 
+    # diurnal scenario: a compressed day of sinusoidal + bursty load
+    # through an elastic fleet (--autoscale, with a mid-day preemption
+    # notice and a bulk scale-to-zero + wake cycle) vs a fixed fleet at
+    # the elastic leg's peak size; gate: elastic within tolerance of
+    # fixed on p99 interactive TTFT at STRICTLY fewer member-hours,
+    # zero drops, clean multi-spill journal audit incl. scale pairing.
+    diurnal = None
+    if args.diurnal > 0:
+        try:
+            diurnal = _diurnal_scenario(args, rng, touch)
+        except Exception as e:  # never discard the decode numbers
+            diurnal = {"error": f"{type(e).__name__}: {e}"}
+            print(f"# diurnal scenario failed: {diurnal['error']}",
+                  file=sys.stderr)
+
     # crash_restart scenario: real subprocess servers (router + two HTTP
     # members, WAL on), kill -9 of a member mid-run (failover) and then
     # of the router itself; restart, WAL recovery, clients reconnect via
@@ -904,6 +934,8 @@ def main() -> int:
         result["fleet"] = fleet
     if tiering is not None:
         result["tiering"] = tiering
+    if diurnal is not None:
+        result["diurnal"] = diurnal
     if crash_restart is not None:
         result["crash_restart"] = crash_restart
     run_done.set()
@@ -1535,6 +1567,305 @@ def _tiering_scenario(args, rng, touch):
         "homogeneous_latency_grade": homo_lat,
         "homogeneous_throughput_grade": homo_thr,
         "regroup_exercise": regroup,
+        "journal_audit_records": audit_records,
+        "journal_audit_violations": len(audit_bad),
+        "pass": gate,
+    }
+
+
+def _diurnal_scenario(args, rng, touch):
+    """Elastic-fleet acceptance: a compressed day of load — a quiet
+    night, a bursty sinusoidal day with a bulk backlog, a quiet night —
+    runs through
+
+      (a) the ELASTIC tiered fleet (--autoscale): starts at interactive
+          r0 + bulk r1, sleeps the idle bulk tier to ZERO overnight,
+          wakes it when the day's backlog arrives (parked work is the
+          wake signal), grows interactive under the burst pressure, and
+          survives a mid-day PREEMPTION NOTICE on a spot member — every
+          size change the drain -> migrate-off -> retire ladder or a
+          journaled spawn; and
+      (b) the FIXED fleet at the elastic leg's observed PEAK size —
+          what an operator without elasticity must keep running all
+          day to hold the same burst.
+
+    Readout per leg: p99/p50 interactive TTFT, member-hours (the
+    resource-cost denominator), scale events by direction/why,
+    preemptions, drops, silent truncations. Gate: elastic holds the
+    fixed leg's p99 interactive TTFT within tolerance at STRICTLY
+    fewer member-hours, zero drops and zero silent truncations through
+    every scale event (incl. the preemption notice and the zero/wake
+    cycle), at least one wake and one idle scale-down, and the
+    multi-spill journal audit (router + seed + provisioned member
+    spills through tools/journal check_files, scale pairing included)
+    comes back clean."""
+    import dataclasses
+    import itertools
+    import os
+    import tempfile
+    import time
+
+    from ollamamq_tpu.config import EngineConfig
+    from ollamamq_tpu.engine.fake import FakeEngine
+    from ollamamq_tpu.fleet import FleetRouter, LocalMember
+    from ollamamq_tpu.ops.sampling import SamplingParams
+    from ollamamq_tpu.telemetry.journal import check_invariants
+    from ollamamq_tpu.tools.journal import check_files
+
+    n_day = args.diurnal
+    n_bulk = max(6, n_day // 2)
+    short_toks, bulk_toks = 2, 10
+    # Elastic p99 tolerance vs fixed: the elastic leg pays a bounded
+    # queueing premium while a scale-up spawns; it must not pay an
+    # unbounded one.
+    tol_mult, tol_abs_ms = 2.0, 150.0
+    base_kw = dict(model="test-tiny", max_slots=4, num_pages=64,
+                   page_size=8, max_pages_per_seq=8,
+                   decode_steps_per_iter=2)
+    tmp = tempfile.mkdtemp(prefix="ollamamq-diurnal-")
+    # Day-phase burst sizes, a one-humped "sinusoid" scaled by n_day —
+    # the midday hump must overflow one interactive member's slots so
+    # the backlog-pressure scale-up path fires, not just the wake.
+    shape = [1, 2, 6, 6, 2, 1]
+    bursts = [max(1, round(n_day * s / sum(shape))) for s in shape]
+
+    def run_leg(tag, elastic, tiers_spec, n_members):
+        ecfg = EngineConfig(
+            journal_file=os.path.join(tmp, f"{tag}-router.jsonl"),
+            tiers=tiers_spec, autoscale=elastic, min_replicas=1,
+            max_replicas=4, scale_cooldown_s=0.3,
+            preemptible="r1" if elastic else None, **base_kw)
+        member_cfg = dataclasses.replace(
+            ecfg, fault_plan=None, max_queued=0, max_queued_per_user=0,
+            tiers=None, autoscale=False, preemptible=None,
+            journal_file=None)
+        spills = [ecfg.journal_file]
+        prov_seq = itertools.count()
+
+        def mkfactory(seed_name=None):
+            def build(tp=None):
+                jf = os.path.join(
+                    tmp, f"{tag}-{seed_name or f'prov{next(prov_seq)}'}"
+                         ".jsonl")
+                spills.append(jf)
+                mcfg = dataclasses.replace(member_cfg, journal_file=jf)
+                return FakeEngine(mcfg, blocklist_path=None,
+                                  token_latency_s=0.02)
+            return build
+
+        members = []
+        for i in range(n_members):
+            f = mkfactory(seed_name=f"r{i}")
+            members.append(LocalMember(f"r{i}", f(), engine_factory=f))
+        router = FleetRouter(
+            members, ecfg, blocklist_path=None, probe_period_s=0.05,
+            eject_heartbeat_s=5.0, reprobe_backoff_s=0.2,
+            evac_grace_s=1.0,
+            tiering_kw=dict(balance=False,
+                            windows=(("fast", 5.0, 1.0, 1.0, "warn"),),
+                            bulk_ttft_ms=150.0),
+            # Hysteresis shrunk to the smoke's timescale; backlog_high
+            # lowered so the midday hump's queue depth reads as
+            # pressure on these tiny members; provisioned members join
+            # as preemptible SPOT capacity — what the mid-day
+            # termination notice reclaims.
+            autoscale_kw=dict(tick_period_s=0.02, cooldown_s=0.3,
+                              sustain_s=0.1, idle_sustain_s=0.25,
+                              backlog_high=2,
+                              provision_preemptible=True))
+        router.start()
+        reqs, kinds, want = [], [], []
+        peak = {"interactive": 0, "bulk": 0, "total": 0}
+        seen = {"zero": False, "preempted": False}
+
+        def issue(user, cls, toks, deadline_ms=None):
+            sp = SamplingParams(max_tokens=toks)
+            if deadline_ms is not None:
+                sp.deadline_ms = deadline_ms
+            reqs.append(router.enqueue_request(
+                user, "", "test-tiny", prompt_tokens=[1] * 4,
+                sampling=sp))
+            kinds.append(cls)
+            want.append(toks)
+
+        def pulse():
+            for r in reqs:
+                r.stream.drain()
+            counts = {"interactive": 0, "bulk": 0}
+            for m in router.members:
+                t = getattr(m, "tier", None)
+                if t in counts and m.state != "ejected":
+                    counts[t] += 1
+            for t in counts:
+                peak[t] = max(peak[t], counts[t])
+            peak["total"] = max(peak["total"], len(router.members))
+            if (router.tiers is not None
+                    and "bulk" in router.tiers.scaled_to_zero):
+                seen["zero"] = True
+            touch("diurnal")
+
+        t0 = time.monotonic()
+        try:
+            # --- night 0: an interactive trickle, nothing for bulk.
+            # The elastic leg's idle bulk member drains off; the tier
+            # sleeps at zero. Phase timings are IDENTICAL across legs —
+            # the member-hours comparison depends on it.
+            i_seq = itertools.count()
+            end = time.monotonic() + 1.2
+            while time.monotonic() < end:
+                issue(f"n{next(i_seq) % 4}", "interactive", short_toks,
+                      deadline_ms=60_000.0)
+                for _ in range(5):
+                    pulse()
+                    time.sleep(0.05)
+            # --- day: the bulk backlog lands (the elastic leg's WAKE
+            # signal) and interactive arrives in sinusoidal bursts.
+            b_seq = itertools.count()
+            bulk_per_step = -(-n_bulk // len(bursts))  # ceil
+            for step, size in enumerate(bursts):
+                for _ in range(bulk_per_step):
+                    if next(b_seq) < n_bulk:
+                        issue(f"b{step % 4}", "bulk", bulk_toks)
+                for _ in range(size):
+                    issue(f"d{next(i_seq) % 8}", "interactive",
+                          short_toks, deadline_ms=60_000.0)
+                # Mid-day spot reclamation: serve a termination notice
+                # on a preemptible member (elastic leg only).
+                if elastic and step == len(bursts) // 2 \
+                        and not seen["preempted"]:
+                    victim = next(
+                        (m for m in router.members
+                         if getattr(m, "preemptible", False)
+                         and m.state == "healthy"
+                         and not getattr(m, "retiring", False)), None)
+                    serving = sum(
+                        1 for m in router.members
+                        if m.state != "ejected"
+                        and not getattr(m, "retiring", False))
+                    if victim is not None and serving > 1:
+                        router.preempt_replica(victim.name,
+                                               notice_s=5.0)
+                        seen["preempted"] = True
+                for _ in range(6):
+                    pulse()
+                    time.sleep(0.05)
+            # --- night 1: arrivals stop; everything drains, then an
+            # evening beat (same length both legs) in which the
+            # elastic fleet shrinks back toward the floor and the
+            # fixed one just keeps burning member-hours.
+            deadline = time.monotonic() + 300.0
+            while any(not r.stats.finished_at for r in reqs):
+                if time.monotonic() > deadline:
+                    raise RuntimeError(f"diurnal leg {tag} wedged")
+                pulse()
+                time.sleep(0.01)
+            end = time.monotonic() + 2.5
+            while time.monotonic() < end:
+                pulse()
+                time.sleep(0.05)
+            elapsed = time.monotonic() - t0
+            pulse()
+
+            def pctl(xs, q):
+                xs = sorted(xs)
+                return (round(xs[min(len(xs) - 1, int(q * len(xs)))], 1)
+                        if xs else None)
+
+            ttfts = [r.stats.ttft_ms for r, k in zip(reqs, kinds)
+                     if k == "interactive" and r.stats.first_token_at]
+            dropped = sum(1 for r in reqs if not r.stats.finished_at)
+            # A stream that finished "normally" with fewer tokens than
+            # it asked for was silently truncated somewhere in a scale
+            # event — the exact bug the drain ladder exists to prevent.
+            silent = sum(
+                1 for r, w in zip(reqs, want)
+                if r.stats.finished_at and r.stats.completion_tokens < w)
+            jrecs = router.journal.tail(None)
+            hours = (router.autoscaler.member_hours() if elastic
+                     else n_members * elapsed / 3600.0)
+            scale = {"up_done": 0, "up_aborted": 0, "down_done": 0,
+                     "down_aborted": 0, "wakes": 0, "idle_downs": 0}
+            for r in jrecs:
+                if r["kind"] == "scale_up" and r.get("phase") == "start" \
+                        and r.get("why") == "wake":
+                    scale["wakes"] += 1
+                if r["kind"] == "scale_down" \
+                        and r.get("phase") == "start" \
+                        and r.get("why") == "idle":
+                    scale["idle_downs"] += 1
+                for kind, key in (("scale_up", "up"), ("scale_down",
+                                                       "down")):
+                    if r["kind"] == kind:
+                        if r.get("phase") == "done":
+                            scale[f"{key}_done"] += 1
+                        elif r.get("phase") == "aborted":
+                            scale[f"{key}_aborted"] += 1
+            out = {
+                "elapsed_s": round(elapsed, 3),
+                "requests": len(reqs),
+                "interactive_ttft_p50_ms": pctl(ttfts, 0.5),
+                "interactive_ttft_p99_ms": pctl(ttfts, 0.99),
+                "member_hours": round(hours, 5),
+                "dropped_streams": dropped,
+                "silent_truncations": silent,
+                "scale_events": scale,
+                "preempt_notices": sum(1 for r in jrecs
+                                       if r["kind"] == "preempt_notice"),
+                "slept_to_zero": seen["zero"],
+                "preempted": seen["preempted"],
+                "peak_members": dict(peak),
+                "final_members": len(router.members),
+                "invariant_violations": len(check_invariants(jrecs)),
+            }
+            return out, spills
+        finally:
+            router.stop()
+
+    elastic, elastic_spills = run_leg(
+        "elastic", True, "interactive=r0;bulk=r1", 2)
+    # The fixed comparator runs all day at the elastic leg's peak —
+    # tier spec rebuilt at the observed per-tier peak counts.
+    n_int = max(1, elastic["peak_members"]["interactive"])
+    n_blk = max(1, elastic["peak_members"]["bulk"])
+    spec = ("interactive=" + ",".join(f"r{i}" for i in range(n_int))
+            + ";bulk=" + ",".join(f"r{i}"
+                                  for i in range(n_int, n_int + n_blk)))
+    fixed, _ = run_leg("fixed", False, spec, n_int + n_blk)
+
+    # Multi-spill audit of the elastic leg: router + seed + provisioned
+    # member journals as ONE run — invariants, zero-drop, regroup AND
+    # scale pairing (a hanging scale_up/scale_down or a lapsed
+    # preemption notice fails here).
+    audit_bad, audit_records = check_files(
+        [p for p in elastic_spills if os.path.exists(p)])
+
+    p99_e = elastic["interactive_ttft_p99_ms"]
+    p99_f = fixed["interactive_ttft_p99_ms"]
+    gate = bool(
+        p99_e is not None and p99_f is not None
+        and p99_e <= p99_f * tol_mult + tol_abs_ms
+        and elastic["member_hours"] < fixed["member_hours"]
+        and elastic["dropped_streams"] == 0
+        and fixed["dropped_streams"] == 0
+        and elastic["silent_truncations"] == 0
+        and fixed["silent_truncations"] == 0
+        and elastic["invariant_violations"] == 0
+        and elastic["slept_to_zero"]
+        and elastic["preempted"]
+        and elastic["preempt_notices"] >= 1
+        and elastic["scale_events"]["wakes"] >= 1
+        and elastic["scale_events"]["up_done"] >= 1
+        and elastic["scale_events"]["down_done"] >= 1
+        and not audit_bad)
+    return {
+        "interactive_requests_day": n_day,
+        "bulk_requests": n_bulk,
+        "ttft_tolerance": {"mult": tol_mult, "abs_ms": tol_abs_ms},
+        "elastic": elastic,
+        "fixed": fixed,
+        "member_hours_saved_pct": round(
+            100.0 * (1.0 - elastic["member_hours"]
+                     / max(1e-12, fixed["member_hours"])), 1),
         "journal_audit_records": audit_records,
         "journal_audit_violations": len(audit_bad),
         "pass": gate,

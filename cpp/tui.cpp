@@ -430,6 +430,25 @@ struct Tui {
         out.push_back(std::string(over ? RED : CYAN) + l + RST);
       }
     }
+    /* Fleet-size chip (elastic fleets only): current size against the
+     * autoscaler's [min, max] band, plus how much of the fleet is
+     * preemptible (spot) capacity that a reclamation notice can take. */
+    auto fsz = stats->get("fleet_size");
+    if (fsz && fsz->type == mj::Value::OBJ) {
+      double fn = fsz->get("n") ? fsz->get("n")->as_num() : 0;
+      double fp =
+          fsz->get("preemptible") ? fsz->get("preemptible")->as_num() : 0;
+      double fmin = fsz->get("min") ? fsz->get("min")->as_num() : 0;
+      double fmax = fsz->get("max") ? fsz->get("max")->as_num() : 0;
+      if (fp > 0)
+        std::snprintf(l, sizeof l,
+                      " fleet %.0f (+%.0f preemptible)  [%.0f..%.0f]",
+                      fn, fp, fmin, fmax);
+      else
+        std::snprintf(l, sizeof l, " fleet %.0f  [%.0f..%.0f]", fn, fmin,
+                      fmax);
+      out.push_back(std::string(CYAN) + l + RST);
+    }
     /* Tiers line (tiered fleets only): healthy/total per replica tier.
      * RED when any tier has ZERO healthy members — that tier's traffic
      * is being served cross-tier (journaled overflow) until a member

@@ -26,6 +26,11 @@ set -- --no-tui --host 0.0.0.0
 [ -n "${MIGRATE_TIMEOUT_S:-}" ] && set -- "$@" --migrate-timeout-s "$MIGRATE_TIMEOUT_S"
 [ -n "${TIERS:-}" ] && set -- "$@" --tiers "$TIERS"
 [ -n "${ROUTER_OVERHEAD_BUDGET_MS:-}" ] && set -- "$@" --router-overhead-budget-ms "$ROUTER_OVERHEAD_BUDGET_MS"
+[ "${AUTOSCALE:-}" = "true" ] && set -- "$@" --autoscale
+[ -n "${MIN_REPLICAS:-}" ] && set -- "$@" --min-replicas "$MIN_REPLICAS"
+[ -n "${MAX_REPLICAS:-}" ] && set -- "$@" --max-replicas "$MAX_REPLICAS"
+[ -n "${SCALE_COOLDOWN_S:-}" ] && set -- "$@" --scale-cooldown-s "$SCALE_COOLDOWN_S"
+[ -n "${PREEMPTIBLE:-}" ] && set -- "$@" --preemptible "$PREEMPTIBLE"
 [ "${FEDERATE_METRICS:-}" = "false" ] && set -- "$@" --no-federate-metrics
 [ -n "${MAX_SLOTS:-}" ] && set -- "$@" --max-slots "$MAX_SLOTS"
 [ -n "${WAL_DIR:-}" ] && set -- "$@" --wal-dir "$WAL_DIR"

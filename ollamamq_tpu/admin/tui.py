@@ -114,6 +114,14 @@ def _engine_stats_brief(engine) -> dict:
             out["replicas"] = fleet()
         except Exception:
             pass
+    # Fleet-size chip (elastic fleets only): `fleet N (+P preemptible)`
+    # with the autoscaler's min/max bounds.
+    scaler = getattr(engine, "autoscaler", None)
+    if scaler is not None:
+        try:
+            out["fleet_size"] = scaler.brief()
+        except Exception:
+            pass
     # Router-overhead chip (fleet router only): the windowed placement
     # p99 against its budget — red in the C++ renderer when the router
     # hot path itself is eating the latency budget.

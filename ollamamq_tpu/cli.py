@@ -183,6 +183,42 @@ def build_parser() -> argparse.ArgumentParser:
                         "(drain -> migrate -> restart -> rejoin) as the "
                         "class mix shifts. Unknown tier names or a tier "
                         "with no members fail startup")
+    # Elastic fleet (fleet/autoscaler.py): SLO-burn-driven sizing.
+    p.add_argument("--autoscale", action="store_true",
+                   default=os.environ.get("AUTOSCALE", "").lower()
+                   in ("1", "true", "yes"),
+                   help="elastic fleet sizing: a per-tier control loop "
+                        "watches sustained SLO burn + queue backlog and "
+                        "scales the fleet one member at a time "
+                        "(provisioned members join via the normal probe "
+                        "path; scale-down is always drain -> migrate -> "
+                        "retire, never a kill). The bulk tier may scale "
+                        "to zero overnight — its queued work parks at "
+                        "the router and wakes the tier. Implies a fleet "
+                        "even with --replicas 1")
+    p.add_argument("--min-replicas", type=int,
+                   default=int(os.environ.get("MIN_REPLICAS", 1)),
+                   help="scale-down floor for the interactive tier (and "
+                        "for an untiered elastic fleet); the bulk tier's "
+                        "floor is 0 (scale-to-zero)")
+    p.add_argument("--max-replicas", type=int,
+                   default=int(os.environ.get("MAX_REPLICAS", 4)),
+                   help="fleet-wide scale-up ceiling")
+    p.add_argument("--scale-cooldown-s", type=float,
+                   default=float(os.environ.get("SCALE_COOLDOWN_S", 30.0)),
+                   help="anti-flap cooldown between scale events; the "
+                        "burn/idle sustain windows derive from it "
+                        "(pressure must hold cooldown/3 before a scale-"
+                        "up, idleness a full cooldown before a scale-"
+                        "down). Waking a scaled-to-zero tier bypasses it")
+    p.add_argument("--preemptible",
+                   default=os.environ.get("PREEMPTIBLE", ""),
+                   help="comma-separated member names (r0, h1, ...) that "
+                        "accept a spot-style termination notice (POST "
+                        "/admin/preempt/{replica} or the fault plan's "
+                        "'preempt' site): live streams migrate off "
+                        "within the notice window, then the member "
+                        "retires — zero dropped streams")
     p.add_argument("--router-overhead-budget-ms", type=float,
                    default=float(os.environ.get(
                        "ROUTER_OVERHEAD_BUDGET_MS", 50.0)),
@@ -497,6 +533,34 @@ def main(argv=None) -> int:
         log.error("--router-overhead-budget-ms must be >= 0 "
                   "(0 disables the alert)")
         return 2
+    roster_names = ([f"r{i}" for i in range(max(0, args.replicas))]
+                    + [f"h{j}" for j in range(len(fleet_urls))])
+    if args.autoscale:
+        # Autoscale knobs fail fast BEFORE any device work — argparse
+        # doesn't validate env-supplied defaults (MIN_REPLICAS etc.), so
+        # a bad compose file must die here, not at the first scale
+        # decision.
+        from ollamamq_tpu.config import validate_autoscale
+
+        scale_err = validate_autoscale(
+            args.min_replicas, args.max_replicas, args.scale_cooldown_s,
+            replicas=args.replicas + len(fleet_urls))
+        if scale_err is not None:
+            log.error("%s", scale_err)
+            return 2
+    if args.preemptible:
+        want = [s.strip() for s in args.preemptible.split(",")
+                if s.strip()]
+        if args.replicas <= 1 and not fleet_urls and not args.autoscale:
+            log.error("--preemptible needs a fleet (--replicas > 1, "
+                      "--replica-urls, or --autoscale)")
+            return 2
+        unknown = sorted(set(want) - set(roster_names))
+        if unknown:
+            log.error("--preemptible names unknown members: %s "
+                      "(fleet: %s)", ", ".join(unknown),
+                      ", ".join(roster_names))
+            return 2
     if args.tiers:
         # Tier spec fails fast BEFORE any device work: unknown tier
         # names, selectors naming no member, and a tier with no members
@@ -624,6 +688,11 @@ def main(argv=None) -> int:
         migrate=not args.no_migrate,
         migrate_timeout_s=args.migrate_timeout_s,
         tiers=args.tiers or None,
+        autoscale=args.autoscale,
+        min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas,
+        scale_cooldown_s=args.scale_cooldown_s,
+        preemptible=args.preemptible or None,
         router_overhead_budget_ms=args.router_overhead_budget_ms,
         federate_metrics=not args.no_federate_metrics,
     )
@@ -632,13 +701,14 @@ def main(argv=None) -> int:
     if args.spmd and args.fake_engine:
         log.error("--spmd and --fake-engine are mutually exclusive")
         return 2
-    if (args.replicas > 1 or fleet_urls) and args.spmd:
-        log.error("--replicas/--replica-urls and --spmd are mutually "
-                  "exclusive (the SPMD engine already owns a worker pool; "
-                  "run the fleet router over separate SPMD services via "
-                  "--replica-urls from a non-SPMD front-end instead)")
+    if (args.replicas > 1 or fleet_urls or args.autoscale) and args.spmd:
+        log.error("--replicas/--replica-urls/--autoscale and --spmd are "
+                  "mutually exclusive (the SPMD engine already owns a "
+                  "worker pool; run the fleet router over separate SPMD "
+                  "services via --replica-urls from a non-SPMD front-end "
+                  "instead)")
         return 2
-    if args.replicas > 1 or fleet_urls:
+    if args.replicas > 1 or fleet_urls or args.autoscale:
         import dataclasses
 
         from ollamamq_tpu.fleet import FleetRouter, HttpMember, LocalMember
@@ -692,10 +762,40 @@ def main(argv=None) -> int:
         for j, url in enumerate(fleet_urls):
             members.append(HttpMember(f"h{j}", url,
                                       timeout_s=args.timeout))
+        provisioner = None
+        if args.autoscale:
+            if args.fake_engine:
+                # The subprocess harness: scale-ups spawn real
+                # `python -m ollamamq_tpu.cli --fake-engine` servers on
+                # free ports and join them as HTTP members — the same
+                # member shape the docker-compose fleet runs. The
+                # member config rides as argv (router-owned caps, WAL,
+                # journal spill all stay OFF member-side).
+                from ollamamq_tpu.fleet.autoscaler import (
+                    SubprocessProvisioner)
+
+                member_argv = [
+                    "--fake-engine", "--models", args.models,
+                    "--scheduler", args.scheduler,
+                    "--max-slots", str(args.max_slots),
+                    "--max-new-tokens", str(args.max_new_tokens),
+                ]
+                provisioner = SubprocessProvisioner(
+                    member_argv, env={"JAX_PLATFORMS": "cpu"})
+            else:
+                # Real engines share the local chips: provision in-
+                # process replicas from the same factory the seed
+                # members use. A cloud provisioner (TPU VM create/
+                # delete) drops in via FleetRouter(provisioner=...).
+                from ollamamq_tpu.fleet.autoscaler import LocalProvisioner
+
+                provisioner = LocalProvisioner(
+                    _member_factory(member_cfg))
         engine = FleetRouter(
             members, ecfg, blocklist_path=args.blocklist,
             fairness=fairness, placement=args.placement,
-            drain_timeout_s=args.drain_timeout_s)
+            drain_timeout_s=args.drain_timeout_s,
+            provisioner=provisioner)
     elif args.spmd:
         import jax
 

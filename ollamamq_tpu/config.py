@@ -371,6 +371,30 @@ class EngineConfig:
     # selector matches default to bulk. None = untiered fleet (every
     # member interchangeable, the pre-tiering behavior).
     tiers: Optional[str] = None
+    # -- elastic fleet (fleet/autoscaler.py) ---------------------------------
+    # SLO-burn-driven autoscaler: a per-tier control loop that watches
+    # sustained burn + queue backlog and resizes the fleet one member at
+    # a time through a MemberProvisioner. Scale-down is always drain ->
+    # migrate-off -> retire (never a kill); the bulk tier may scale to
+    # zero (queued bulk work parks at the router and wakes it), while
+    # `interactive` keeps the min_replicas floor. Off = fixed fleet.
+    autoscale: bool = False
+    # Fleet-size bounds for the scaler: min_replicas is the floor for
+    # the interactive tier (and untiered fleets); max_replicas caps the
+    # whole fleet.
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # Hysteresis: after any scale event the scaler holds its fire this
+    # long (TierBalancer discipline — the burn/idle signal must also be
+    # SUSTAINED, with sustain windows derived from this knob). Waking a
+    # scaled-to-zero tier with parked work bypasses the cooldown: parked
+    # streams must never wait out a timer that exists to stop flapping.
+    scale_cooldown_s: float = 30.0
+    # Comma-separated member names flagged preemptible (spot-style
+    # capacity): POST /admin/preempt/{replica} — or the fault plan's
+    # "preempt_notice" site — serves them a termination notice that
+    # triggers migrate-off-then-retire within the notice window.
+    preemptible: Optional[str] = None
     # -- scheduling policy (engine/scheduler.py) -----------------------------
     # Admission / prefill-packing / preemption-victim ordering: "fcfs"
     # (default; bit-identical to the pre-policy-extraction engine),
@@ -432,6 +456,27 @@ def validate_scheduler(name: str) -> Optional[str]:
     not at the first admission pass."""
     if name not in SCHEDULERS:
         return f"--scheduler must be one of {SCHEDULERS}, got {name!r}"
+    return None
+
+
+def validate_autoscale(min_replicas: int, max_replicas: int,
+                       scale_cooldown_s: float,
+                       replicas: int) -> Optional[str]:
+    """Fail-fast --autoscale validation BEFORE any device work: returns
+    an error string (None = valid). Shared by the CLI and the deploy
+    plumbing so a bad MIN_REPLICAS/MAX_REPLICAS env kills the process at
+    startup, not at the scaler's first decision."""
+    if min_replicas < 1:
+        return (f"--min-replicas must be >= 1 (the interactive floor), "
+                f"got {min_replicas}")
+    if max_replicas < min_replicas:
+        return (f"--max-replicas ({max_replicas}) must be >= "
+                f"--min-replicas ({min_replicas})")
+    if scale_cooldown_s <= 0:
+        return (f"--scale-cooldown-s must be > 0, got {scale_cooldown_s}")
+    if replicas > max_replicas:
+        return (f"starting fleet size --replicas {replicas} exceeds "
+                f"--max-replicas {max_replicas}")
     return None
 
 

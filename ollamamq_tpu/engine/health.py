@@ -55,6 +55,15 @@ PREEMPT_STORM_WINDOW_S = 60.0
 # burning capacity on churn (alert "regroup_storm", resolves when the
 # rate drops).
 REGROUP_STORM_PER_MIN = 4.0
+# Scale-storm rule (elastic fleets): the autoscaler's hysteresis exists
+# so an oscillating load produces ZERO scale events — sustained churn
+# above this rate means the cooldown/sustain windows are mis-tuned for
+# the workload and the fleet is paying spawn + drain + migration costs
+# in a loop (alert "scale_storm", resolves when the rate drops). Unlike
+# the preempt/regroup storms this one counts into
+# ollamamq_watchdog_stalls_total{kind="scale"}: a flapping scaler is a
+# watchdog-grade malfunction, not graceful degradation.
+SCALE_STORM_PER_MIN = 6.0
 
 
 class HealthMonitor:
@@ -254,6 +263,7 @@ class HealthMonitor:
 
         self._check_preempt_storm()
         self._check_regroup_storm()
+        self._check_scale_storm()
         self._check_router_overhead()
         self._check_journal_invariants()
 
@@ -326,6 +336,28 @@ class HealthMonitor:
                 "drain + migrations + a restart", source="watchdog")
         else:
             alerts.resolve("regroup_storm")
+
+    def _check_scale_storm(self) -> None:
+        """Watchdog rule for autoscaler flap (elastic fleets only: the
+        engine exposes an AutoscalerManager at `.autoscaler`). Routed
+        through _alert — each fire transition counts into
+        ollamamq_watchdog_stalls_total{kind="scale"} — because a scaler
+        churning members is a control-loop malfunction the operator
+        must tune out, not load the fleet absorbs gracefully."""
+        scaler = getattr(self.engine, "autoscaler", None)
+        if scaler is None:
+            return
+        try:
+            rate = scaler.scale_rate_per_min()
+        except Exception:  # noqa: BLE001
+            log.exception("scale-rate read failed")
+            return
+        self._alert(
+            "scale_storm", rate > SCALE_STORM_PER_MIN, "warn",
+            f"scale storm: {rate:.0f} scale events/min — the autoscaler "
+            "is flapping fleet size (cooldown/sustain mis-tuned for "
+            "this load); each flap costs a spawn or a drain + "
+            "migrations", "scale")
 
     def _check_router_overhead(self) -> None:
         """Overhead-storm rule (fleet routers only: the engine exposes

@@ -142,6 +142,22 @@ class _MemberBase:
         # (crash mid-retier, restart failure) leaves the ORIGINAL tier.
         self.tier: Optional[str] = None
         self.retier_to: Optional[str] = None
+        # Elastic fleet (fleet/autoscaler.py): `preemptible` marks
+        # spot-style capacity that accepts a termination notice
+        # (migrate-off-then-retire within the notice window instead of
+        # failover); `retiring` is set while a scale-down/preempt drain
+        # is in flight — when the drain empties, the router STOPS the
+        # member and removes it from the roster instead of restarting
+        # it. An eject mid-retire aborts the retire (scale_down
+        # aborted); the member heals back through the normal re-probe
+        # path and stays in rotation.
+        self.preemptible: bool = False
+        self.retiring: bool = False
+        self.retire_why: Optional[str] = None
+        # Scaler-provisioned members carry their provisioner handle so
+        # retire can tear down what provision built (a subprocess, a
+        # cloud VM) — operator-defined members have None and just stop.
+        self.provisioned_by = None
 
     def force_stale(self, delay_s: float) -> None:
         self.forced_stale_until = time.monotonic() + float(delay_s)

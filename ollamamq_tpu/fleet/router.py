@@ -132,7 +132,9 @@ class FleetRouter:
                  migrate: Optional[bool] = None,
                  migrate_timeout_s: Optional[float] = None,
                  tiers: Optional[str] = None,
-                 tiering_kw: Optional[dict] = None):
+                 tiering_kw: Optional[dict] = None,
+                 provisioner=None,
+                 autoscale_kw: Optional[dict] = None):
         assert members, "a fleet needs at least one member"
         if placement not in ("affinity", "least_loaded"):
             raise ValueError(f"unknown placement policy {placement!r} "
@@ -252,6 +254,40 @@ class FleetRouter:
                                      core=self.core, journal=self.journal,
                                      ecfg=engine_cfg,
                                      **(tiering_kw or {}))
+        # Preemptible members (fleet/autoscaler.py): flagged members
+        # accept a termination notice (POST /admin/preempt/{replica} or
+        # the fault plan's "preempt" site) -> migrate-off-then-retire
+        # within the notice window. Flags work WITHOUT the autoscaler.
+        preempt_spec = getattr(engine_cfg, "preemptible", None)
+        if preempt_spec:
+            want = {s.strip() for s in str(preempt_spec).split(",")
+                    if s.strip()}
+            unknown = want - set(names)
+            if unknown:
+                raise ValueError(
+                    f"--preemptible names unknown members: "
+                    f"{', '.join(sorted(unknown))} (fleet: "
+                    f"{', '.join(names)})")
+            for mem in self.members:
+                if mem.name in want:
+                    mem.preemptible = True
+        # Elastic fleet (fleet/autoscaler.py): SLO-burn-driven sizing
+        # behind --autoscale. None = fixed fleet, as before.
+        self.autoscaler = None
+        if getattr(engine_cfg, "autoscale", False):
+            from ollamamq_tpu.fleet.autoscaler import (AutoscalerManager,
+                                                       LocalProvisioner)
+
+            if provisioner is None:
+                factory = getattr(self.members[0], "engine_factory", None)
+                if factory is None:
+                    raise ValueError(
+                        "--autoscale needs a MemberProvisioner (none "
+                        "given, and the seed members carry no engine "
+                        "factory to build a LocalProvisioner from)")
+                provisioner = LocalProvisioner(factory)
+            self.autoscaler = AutoscalerManager(
+                self, provisioner, **(autoscale_kw or {}))
         for mem in self.members:
             self.journal.record("replica_join", replica=mem.name,
                                 why="start")
@@ -288,7 +324,12 @@ class FleetRouter:
             self.health = None
         for mem in self.members:
             try:
-                mem.stop()
+                if getattr(mem, "provisioned_by", None) is not None:
+                    # Tear down what the provisioner built (e.g. kill
+                    # the subprocess behind an HttpMember).
+                    mem.provisioned_by.retire(mem)
+                else:
+                    mem.stop()
             except Exception:  # noqa: BLE001
                 log.exception("stopping member %s failed", mem.name)
         if self.durability is not None:
@@ -416,15 +457,25 @@ class FleetRouter:
         finishes land in the router tracer's window, so the estimate
         tracks the whole fleet's drain rate (and degrades honestly when
         a replica is ejected) instead of one member's share overstating
-        the wait."""
+        the wait.
+
+        Scaled-to-zero wrinkle: with a tier parked at zero members its
+        completion rate is a stale window (or nothing), and a
+        Retry-After computed from it tells clients to hammer a fleet
+        that first has to WAKE — so when the autoscaler has a tier at
+        zero, the estimate adds the wake + spawn time on top of the
+        queue estimate."""
         queued = max(1, self.core.total_queued())
+        wake = (self.autoscaler.wake_wait_s()
+                if self.autoscaler is not None else 0.0)
         window = self.tracer.finish_times
         if window and len(window) >= 2:
             span = window[-1] - window[0]
             if span > 0:
                 rate = (len(window) - 1) / span
-                return float(min(300.0, max(1.0, queued / rate)))
-        return float(min(10.0, max(2.0, float(queued))))
+                return float(min(300.0, wake + max(1.0, queued / rate)))
+        return float(min(300.0,
+                         wake + min(10.0, max(2.0, float(queued)))))
 
     # -------------------------------------------------------------- ingress
     def enqueue_request(self, user: str, ip: str, model: str, family=None,
@@ -556,6 +607,11 @@ class FleetRouter:
             # Balancer tick: retier ONE member toward the observed class
             # mix once the hysteresis clears (no-op most ticks).
             self.tiers.maybe_balance(self)
+        if self.autoscaler is not None:
+            # Elastic sizing AFTER the balancer: regroup/retire are
+            # mutually exclusive, and the scaler parks while any
+            # balancer move is in flight.
+            self.autoscaler.tick()
         # Drain BEFORE admission: a draining member's migrating streams
         # get first claim on slots other members just freed — fresh
         # placements must not starve the evacuation that unblocks the
@@ -826,6 +882,10 @@ class FleetRouter:
                 # the placement policy is protecting.
                 self.tiers.record_ttft(flight.tier,
                                        flight.req.stats.ttft_ms)
+            elif self.autoscaler is not None:
+                # Untiered elastic fleet: the scaler's own objective is
+                # the burn signal the tier engine would otherwise give.
+                self.autoscaler.record_ttft(flight.req.stats.ttft_ms)
         # Empty-text items still forward: they carry the sampled token
         # ids the NDJSON writer folds into the next written frame.
         flight.req.stream.push(item)
@@ -1164,8 +1224,9 @@ class FleetRouter:
         if now - self._last_probe < self.probe_period_s:
             return
         self._last_probe = now
-        for mem in self.members:
+        for mem in list(self.members):
             plan_holds_down = self._draw_faults(mem)
+            self._draw_preempt(mem)
             if mem.state == "healthy":
                 age = mem.heartbeat_age()
                 fatal = mem.fatal_alerts()
@@ -1207,6 +1268,34 @@ class FleetRouter:
                 mem.force_stale(rule.delay_s)
         return holds
 
+    def _draw_preempt(self, mem) -> None:
+        """Evaluate the "preempt" fault site for this member's probe
+        slot — the chaos seam for spot reclamation. A fired rule serves
+        the member a termination notice: "exception" with the default
+        (drain-timeout) window, "slow" with the rule's delay_s as the
+        notice window. Fires on non-preemptible members are ignored —
+        the plan indexes (sweep, member) over the whole roster."""
+        if self.fault_plan is None:
+            return
+        try:
+            fired = self.fault_plan.draw("preempt")
+        except Exception:  # noqa: BLE001
+            log.exception("fault-plan draw failed")
+            return
+        for kind, rule in fired:
+            if kind not in ("exception", "slow"):
+                continue
+            if not getattr(mem, "preemptible", False) \
+                    or getattr(mem, "retiring", False) \
+                    or mem.state == "ejected":
+                continue
+            notice = rule.delay_s if kind == "slow" else None
+            try:
+                self.preempt_replica(mem.name, notice_s=notice)
+            except (KeyError, ValueError, RuntimeError) as e:
+                log.warning("planned preemption of %s skipped: %s",
+                            mem.name, e)
+
     def _eject(self, mem, why: str, age: float) -> None:
         victims = [f for f in self.flights
                    if f.member is mem and not f.done
@@ -1221,6 +1310,11 @@ class FleetRouter:
             # the normal eject ladder below (migrate -> recompute ->
             # never drop).
             self._abort_retier(mem, f"eject:{why}")
+        if getattr(mem, "retiring", False):
+            # A crash mid-retire aborts the retire: the member heals
+            # through the normal re-probe path and stays in rotation;
+            # the scaler re-decides from live signals.
+            self._abort_retire(mem, f"eject:{why}")
         self.journal.record(
             "replica_eject", replica=mem.name, why=why,
             victims=len(victims),
@@ -1332,6 +1426,135 @@ class FleetRouter:
                     mem.name, inflight)
         self._update_gauges()
         self.notify()
+
+    # ------------------------------------------------------------- retiring
+    def retire_replica(self, name: str, why: str = "manual",
+                       timeout_s: Optional[float] = None,
+                       burn: Optional[float] = None,
+                       queued: Optional[int] = None) -> dict:
+        """Permanently remove one member: drain (no new placements),
+        migrate its live streams off, then drop it from the roster and
+        tear it down — NEVER a kill. The autoscaler's scale-down and
+        spot preemption both land here; callable from any thread (HTTP
+        admin). Journaled as a paired scale_down start -> done/aborted
+        regardless of who asked, so the journal checker audits every
+        retire with one vocabulary."""
+        mem = self._member(name)
+        if mem is None:
+            raise KeyError(f"no replica named {name!r} "
+                           f"(members: {[m.name for m in self.members]})")
+        if mem.state == "ejected":
+            raise RuntimeError(
+                f"replica {name} is ejected; retire applies to serving "
+                "replicas (eject it from the config instead)")
+        if getattr(mem, "retiring", False):
+            raise RuntimeError(f"replica {name} is already retiring")
+        if mem.retier_to is not None:
+            raise RuntimeError(f"replica {name} is mid-regroup; retire "
+                               "after the regroup settles")
+        serving = [m for m in self.members
+                   if m.state != "ejected"
+                   and not getattr(m, "retiring", False)]
+        if len(serving) <= 1:
+            raise RuntimeError(
+                f"replica {name} is the fleet's last serving member; "
+                "a retire must never empty the fleet")
+        inflight = self._load_of(mem)
+        mem.retiring = True
+        mem.retire_why = why
+        self.journal.record(
+            "scale_down", replica=mem.name, phase="start",
+            tier=getattr(mem, "tier", None), why=why,
+            burn=burn, queued=queued, inflight=inflight,
+            fleet=len(self.members))
+        log.warning("replica %s retiring (%s): draining, %d in-flight "
+                    "stream(s) migrate off, then it leaves the fleet",
+                    mem.name, why, inflight)
+        if mem.state != "draining":
+            self._start_drain(mem, timeout_s)
+        return {"replica": mem.name, "state": mem.state, "why": why,
+                "inflight": inflight}
+
+    def preempt_replica(self, name: str,
+                        notice_s: Optional[float] = None) -> dict:
+        """Termination notice for a preemptible member — the spot-
+        reclamation path (POST /admin/preempt/{replica}, or the fault
+        plan's "preempt" site). Migrate-off-then-retire within the
+        notice window; past the deadline the stragglers fail over via
+        the drain-timeout ladder. Either way: zero dropped streams."""
+        mem = self._member(name)
+        if mem is None:
+            raise KeyError(f"no replica named {name!r} "
+                           f"(members: {[m.name for m in self.members]})")
+        if not getattr(mem, "preemptible", False):
+            raise ValueError(
+                f"replica {name} is not preemptible (flag members with "
+                "--preemptible)")
+        notice = float(notice_s) if notice_s else self.drain_timeout_s
+        self.journal.record(
+            "preempt_notice", replica=mem.name,
+            tier=getattr(mem, "tier", None),
+            notice_s=round(notice, 1), inflight=self._load_of(mem))
+        tm.FLEET_PREEMPTIONS_TOTAL.inc()
+        log.warning("replica %s served a termination notice (%.1fs "
+                    "window)", mem.name, notice)
+        return self.retire_replica(name, why="preempt", timeout_s=notice)
+
+    def _abort_retire(self, mem, why: str) -> None:
+        """A retire died before the member left the roster (crash mid-
+        drain): journal the abort; the member stays in rotation and
+        heals through the normal re-probe path."""
+        mem.retiring = False
+        mem.retire_why = None
+        self.journal.record(
+            "scale_down", replica=mem.name, phase="aborted",
+            tier=getattr(mem, "tier", None), why=why,
+            fleet=len(self.members))
+        if self.autoscaler is not None:
+            # note_scale_event owns the metric + the storm/cooldown
+            # bookkeeping when a scaler is running.
+            self.autoscaler.note_scale_event("down", "aborted")
+        else:
+            tm.FLEET_SCALE_EVENTS_TOTAL.labels(direction="down",
+                                               outcome="aborted").inc()
+        log.error("replica %s retire ABORTED (%s); member stays in "
+                  "rotation", mem.name, why)
+
+    def _complete_retire(self, mem) -> None:
+        """Retire drain emptied: the member leaves the roster and its
+        provisioner (or stop()) tears it down. Scale-to-zero lands
+        here too — when the autoscaler removes a tier's last member the
+        tier is marked parked, so its queued work HOLDS at the router
+        (the wake signal) instead of spilling cross-tier."""
+        why = getattr(mem, "retire_why", None) or "manual"
+        self.members = [m for m in self.members if m is not mem]
+        if self.tiers is not None:
+            # Deliberate zero only under an autoscaler that can wake
+            # the tier back up; a manual retire emptying a tier falls
+            # back to the cross-tier spill path.
+            self.tiers.note_member_removed(
+                mem, to_zero=self.autoscaler is not None)
+        try:
+            if getattr(mem, "provisioned_by", None) is not None:
+                mem.provisioned_by.retire(mem)
+            else:
+                mem.stop()
+        except Exception:  # noqa: BLE001
+            log.exception("teardown of retired member %s failed",
+                          mem.name)
+        mem.retiring = False
+        self.journal.record(
+            "scale_down", replica=mem.name, phase="done",
+            tier=getattr(mem, "tier", None), why=why,
+            fleet=len(self.members))
+        if self.autoscaler is not None:
+            self.autoscaler.note_scale_event("down", "done")
+        else:
+            tm.FLEET_SCALE_EVENTS_TOTAL.labels(direction="down",
+                                               outcome="done").inc()
+        log.warning("replica %s retired (%s); fleet -> %d member(s)",
+                    mem.name, why, len(self.members))
+        self._update_gauges()
 
     # ----------------------------------------------------------- regrouping
     def retier_replica(self, name: str, tier: str,
@@ -1455,7 +1678,9 @@ class FleetRouter:
 
     def _drain_progress(self) -> None:
         now = time.monotonic()
-        for mem in self.members:
+        # Copy: _complete_retire removes the member from the roster
+        # mid-iteration.
+        for mem in list(self.members):
             if mem.state != "draining":
                 continue
             active = [f for f in self.flights
@@ -1482,6 +1707,11 @@ class FleetRouter:
             active = [f for f in self.flights
                       if f.member is mem and not f.done]
             if not active:
+                if getattr(mem, "retiring", False):
+                    # Retire drain emptied: the member leaves the
+                    # fleet for good (scale-down / preemption).
+                    self._complete_retire(mem)
+                    continue
                 if mem.retier_to is not None:
                     # Regroup drain emptied: restart at the target
                     # tier's width and commit (or abort) the move.
@@ -1609,6 +1839,10 @@ class FleetRouter:
             }
             if mem.tier is not None:
                 row["tier"] = mem.tier
+            if getattr(mem, "preemptible", False):
+                row["preemptible"] = True
+            if getattr(mem, "retiring", False):
+                row["retiring"] = True
             rows.append(row)
         return {
             "placement": self.placement,
@@ -1623,6 +1857,8 @@ class FleetRouter:
             "queued": self.core.total_queued(),
             "tiers": (self.tiers.status() if self.tiers is not None
                       else None),
+            "autoscaler": (self.autoscaler.status()
+                           if self.autoscaler is not None else None),
             "router_overhead": self.router_overhead_stats(),
         }
 

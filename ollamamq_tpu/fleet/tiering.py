@@ -142,6 +142,13 @@ class TierManager:
         self.regroup_times: collections.deque = collections.deque(maxlen=64)
         self.regroup_counts = {"done": 0, "aborted": 0}
         self.overflow_count = 0
+        # Elastic fleet (fleet/autoscaler.py): tiers the scaler has
+        # DELIBERATELY emptied. A scaled-to-zero tier's traffic parks at
+        # the router (tier isolation) instead of taking the empty-tier
+        # cross-tier fallback — the parked backlog is the pending-work
+        # signal that wakes the tier back up. Distinct from a tier whose
+        # members all crashed: that one still spills cross-tier.
+        self.scaled_to_zero: set = set()
         self._class_cache = (0.0, None, None)  # (ts, vip, boost)
         self._burn_cache: Dict[str, tuple] = {}  # tier -> (ts, active, burn)
         self._last_gauges = 0.0
@@ -251,6 +258,13 @@ class TierManager:
                       if getattr(m, "tier", None) == tier
                       and m.state == "healthy"]
         if not home_alive and elig:
+            if tier in self.scaled_to_zero:
+                # Deliberately scaled to zero: PARK (the stream waits at
+                # the router; its presence in the pending set is the
+                # autoscaler's wake signal) instead of leaking onto the
+                # other tier's members.
+                info.update(why="parked")
+                return [], info
             info.update(why="no_members")
             return list(elig), info
         return [], info
@@ -333,6 +347,8 @@ class TierManager:
             donor_tier = "interactive"
         else:
             return
+        if other_tier(donor_tier) in self.scaled_to_zero:
+            return  # don't repopulate a tier the scaler emptied on purpose
         donors = [m for m in self._tier_members(donor_tier)
                   if m.state == "healthy"
                   and getattr(m, "retier_to", None) is None]
@@ -352,6 +368,26 @@ class TierManager:
         self.regroup_times.append(time.monotonic())
         self.last_regroup_at = time.monotonic()
         self.samples_since_regroup = 0
+
+    # ------------------------------------------------- elastic-fleet roster
+    def note_member_added(self, mem, tier: str) -> None:
+        """A scaler-provisioned member joined: label it, add it to the
+        tier roster, and clear any scale-to-zero park on its tier (the
+        wake)."""
+        mem.tier = tier
+        self._members.append(mem)
+        self.scaled_to_zero.discard(tier)
+        self.update_gauges()
+
+    def note_member_removed(self, mem, to_zero: bool = False) -> None:
+        """A member retired (scale-down / preemption). `to_zero` marks a
+        DELIBERATE tier emptying: its traffic parks instead of spilling
+        cross-tier until the scaler wakes the tier."""
+        self._members = [m for m in self._members if m is not mem]
+        tier = getattr(mem, "tier", None)
+        if to_zero and tier is not None and not self._tier_members(tier):
+            self.scaled_to_zero.add(tier)
+        self.update_gauges()
 
     def regroup_rate_per_min(self, window_s: float = 60.0) -> float:
         """Regroups per minute over the trailing window — the health

@@ -161,6 +161,10 @@ class Server:
             r.add_route("GET", "/admin/tiers", self.admin_tiers)
             r.add_route("POST", "/admin/retier/{replica}",
                         self.admin_retier)
+            # Elastic fleet (--autoscale / --preemptible): spot-style
+            # termination notice -> migrate-off-then-retire.
+            r.add_route("POST", "/admin/preempt/{replica}",
+                        self.admin_preempt)
         # KV migration wire (only when the engine IS an engine, not a
         # router): the fleet's HttpMember speaks these to ship a live
         # stream's pages + request state between member services.
@@ -802,6 +806,34 @@ class Server:
                                              why="admin")
         except AttributeError:
             raise ApiError(404, "fleet is untiered (--tiers not set)")
+        except KeyError as e:
+            raise ApiError(404, str(e.args[0]) if e.args else str(e))
+        except ValueError as e:
+            raise ApiError(400, str(e))
+        except RuntimeError as e:
+            raise ApiError(409, str(e))
+        return web.json_response({"status": "success", **out})
+
+    async def admin_preempt(self, request: web.Request) -> web.Response:
+        """Serve one preemptible replica a termination notice (the spot-
+        reclamation path): its live streams migrate off within the
+        notice window, then it retires from the fleet — zero dropped
+        streams. Body: {"notice_s": N?} (default: the drain timeout).
+        Poll GET /admin/fleet until the replica leaves the roster
+        (scale_down done in the journal)."""
+        self._ident(request)
+        name = request.match_info["replica"]
+        body = await self._body_json(request)
+        notice_s = None
+        if "notice_s" in body:
+            try:
+                notice_s = float(body["notice_s"])
+            except (TypeError, ValueError):
+                raise ApiError(400, "'notice_s' must be a number")
+            if notice_s <= 0:
+                raise ApiError(400, "'notice_s' must be > 0")
+        try:
+            out = self.engine.preempt_replica(name, notice_s=notice_s)
         except KeyError as e:
             raise ApiError(404, str(e.args[0]) if e.args else str(e))
         except ValueError as e:

@@ -91,6 +91,17 @@ EVENTS = (
     # recovery.
     "wal_admit",         # request durably logged (fsynced) pre-ACK
     "recover_replay",    # WAL'd unfinished request re-admitted at start
+    # Elastic fleet (fleet/autoscaler.py): SLO-burn-driven fleet sizing.
+    "scale_up",          # scaler grew a tier, by phase: start (decision
+    #                      made, provisioning began) / done (member
+    #                      joined rotation) / aborted (spawn failed)
+    "scale_down",        # a member retired (drain -> migrate-off ->
+    #                      stop), by phase: start / done / aborted; also
+    #                      records preemption-notice retires (why:
+    #                      "preempt") and manual ones (why: "manual")
+    "preempt_notice",    # a preemptible member was served a termination
+    #                      notice; resolved by a scale_down for the same
+    #                      member within the notice window
 )
 
 # kind -> (required fields, optional fields) beyond the common header
@@ -191,6 +202,18 @@ EVENT_FIELDS: Dict[str, Tuple[tuple, tuple]] = {
     # records use); `wal_rid` is the pre-crash id the client still
     # holds — the resume endpoint aliases the two.
     "recover_replay": (("tokens",), ("outcome", "n_prompt", "wal_rid")),
+    # Scale records carry the control-loop inputs that justified the
+    # decision: which tier moved, the burn rate and queue backlog at
+    # decision time, and the fleet size it moved toward. scale_up's
+    # done-phase records the measured spawn cost (what a scaled-to-zero
+    # tier's Retry-After must account for); scale_down's start-phase
+    # records the in-flight work the drain must migrate off first.
+    "scale_up": (("replica", "phase"),
+                 ("tier", "why", "burn", "queued", "fleet", "spawn_ms")),
+    "scale_down": (("replica", "phase"),
+                   ("tier", "why", "burn", "queued", "fleet", "inflight")),
+    "preempt_notice": (("replica",),
+                       ("tier", "notice_s", "why", "inflight")),
 }
 assert set(EVENT_FIELDS) == set(EVENTS)
 
@@ -208,7 +231,8 @@ DECISION_KINDS = ("enqueue", "admit", "sched", "place", "shed", "batch",
                   "replica_failover", "replica_drain", "replica_join",
                   "tier_place", "tier_overflow", "tier_regroup",
                   "migrate_export", "migrate_import", "migrate_abort",
-                  "recover_replay")
+                  "recover_replay", "scale_up", "scale_down",
+                  "preempt_notice")
 
 # High-rate bookkeeping kinds eligible for probabilistic sampling
 # (--journal-sample < 1): each record is self-contained (page events
@@ -233,6 +257,9 @@ _SIG_FIELDS = {
     "tier_place": ("tier", "cls"),
     "tier_overflow": ("from_tier", "to_tier", "why"),
     "tier_regroup": ("replica", "phase", "from_tier", "to_tier"),
+    "scale_up": ("replica", "phase", "tier"),
+    "scale_down": ("replica", "phase", "why"),
+    "preempt_notice": ("replica",),
 }
 
 
@@ -623,6 +650,44 @@ def explain(rec: dict) -> str:
                 f"({rec.get('outcome', 'replayed')}: "
                 f"{rec.get('tokens', '?')} already-emitted token(s) "
                 "restored without recompute)")
+    if kind == "scale_up":
+        phase = rec.get("phase", "?")
+        s = (f"scaler growing tier {rec.get('tier', 'fleet')}: "
+             f"member {rec.get('replica', '?')} {phase}")
+        if rec.get("why"):
+            s += f" ({rec['why']}"
+            if rec.get("burn") is not None:
+                s += f", burn {rec['burn']:.1f}x budget"
+            if rec.get("queued") is not None:
+                s += f", {rec['queued']} queued"
+            s += ")"
+        if phase == "done" and rec.get("spawn_ms") is not None:
+            s += f", spawned in {rec['spawn_ms']:.0f}ms"
+        if rec.get("fleet") is not None:
+            s += f"; fleet -> {rec['fleet']}"
+        return s
+    if kind == "scale_down":
+        phase = rec.get("phase", "?")
+        s = (f"scaler retiring member {rec.get('replica', '?')} "
+             f"from tier {rec.get('tier', 'fleet')} {phase}")
+        if rec.get("why"):
+            s += f" ({rec['why']})"
+        if phase == "start" and rec.get("inflight") is not None:
+            s += (f", {rec['inflight']} in-flight stream(s) migrating "
+                  "off first")
+        if phase == "aborted":
+            s += "; member stays in rotation"
+        if rec.get("fleet") is not None:
+            s += f"; fleet -> {rec['fleet']}"
+        return s
+    if kind == "preempt_notice":
+        s = (f"preemptible member {rec.get('replica', '?')} served a "
+             f"termination notice")
+        if rec.get("notice_s") is not None:
+            s += f" ({rec['notice_s']:g}s window)"
+        if rec.get("inflight") is not None:
+            s += f", {rec['inflight']} in-flight stream(s) to migrate off"
+        return s
     return f"{kind} {who}"
 
 

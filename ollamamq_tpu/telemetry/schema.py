@@ -194,7 +194,7 @@ SLO_ALERTS_FIRING = REGISTRY.gauge(
 WATCHDOG_STALLS_TOTAL = REGISTRY.counter(
     "ollamamq_watchdog_stalls_total",
     "Stall watchdog firings by kind (engine_step, request_phase, "
-    "worker_host, device, replica)", labels=("kind",))
+    "worker_host, device, replica, scale)", labels=("kind",))
 
 # -- decision journal (telemetry/journal.py; GET /debug/journal) -----------
 JOURNAL_EVENTS_TOTAL = REGISTRY.counter(
@@ -285,6 +285,27 @@ FLEET_MIGRATE_BYTES_TOTAL = REGISTRY.counter(
     "ollamamq_fleet_migrate_bytes_total",
     "KV page payload bytes shipped between fleet members (migrations "
     "and prefix shipping; int8 pools move ~2x fewer bytes than bf16)")
+
+# -- elastic fleet (fleet/autoscaler.py; --autoscale) ----------------------
+FLEET_SCALE_EVENTS_TOTAL = REGISTRY.counter(
+    "ollamamq_fleet_scale_events_total",
+    "Autoscaler fleet-size changes by direction ('up' = member "
+    "provisioned and joined, 'down' = member drained, migrated off, and "
+    "retired) and outcome ('done' or 'aborted': a failed spawn, or an "
+    "eject mid-retire) — every one journaled as scale_up/scale_down "
+    "with the burn + backlog inputs that justified it",
+    labels=("direction", "outcome"))
+FLEET_MEMBER_HOURS_TOTAL = REGISTRY.counter(
+    "ollamamq_fleet_member_hours_total",
+    "Cumulative member-serving hours (fractional; accrued each scaler "
+    "tick over every non-ejected member) — the resource-cost side of "
+    "the elastic-fleet ledger the diurnal bench gates on")
+FLEET_PREEMPTIONS_TOTAL = REGISTRY.counter(
+    "ollamamq_fleet_preemptions_total",
+    "Termination notices served to preemptible members (POST "
+    "/admin/preempt/{replica} or the fault plan's preempt_notice site); "
+    "each triggers migrate-off-then-retire within the notice window — "
+    "spot reclamation with zero dropped streams")
 
 # -- crash durability (durability/; --wal-dir) -----------------------------
 WAL_FSYNC_MS = REGISTRY.histogram(

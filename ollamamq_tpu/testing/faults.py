@@ -47,7 +47,13 @@ Each rule names ONE site and ONE trigger:
            trouble and DEGRADES the WAL loudly — serving continues
            without crash durability, the wal_degraded alert fires —
            and "slow" stalls the fsync, stretching the admission-ACK
-           latency the group commit is supposed to bound).
+           latency the group commit is supposed to bound), or the
+           elastic fleet's spot-reclamation seam ("preempt", drawn per
+           member each health sweep like "replica": "exception" serves
+           a preemptible member a termination notice with the default
+           drain-timeout window, "slow" serves one with delay_s as the
+           notice window; fires on non-preemptible members are
+           ignored).
   kind     "exception"  -> the dispatch raises FaultInjected (the
                            engine's retry/containment path handles it);
            "slow"       -> the dispatch sleeps delay_s first (stall
@@ -84,7 +90,7 @@ from typing import Dict, List, Optional
 
 SITES = ("prefill", "chunk", "sp_prefill", "ragged", "spec_verify",
          "decode", "embed", "encode", "step", "alloc", "extend", "replica",
-         "migrate", "wal")
+         "migrate", "wal", "preempt")
 KINDS = ("exception", "slow", "alloc_fail", "device_loss")
 
 _RULE_KEYS = {"site", "kind", "at", "every", "p", "times", "delay_s",

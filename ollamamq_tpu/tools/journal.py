@@ -202,6 +202,57 @@ def check_regroup_pairing(records: List[dict]) -> List[str]:
     return bad
 
 
+def check_scale_pairing(records: List[dict]) -> List[str]:
+    """Elastic-fleet scale audit (end-of-run semantics, like the
+    regroup pairing): every `scale_up` / `scale_down` phase="start"
+    must resolve to a "done" or an "aborted" for the same replica by
+    journal end — a scale_up left hanging is a spawn that never joined
+    (nor journaled its failure); a scale_down left hanging is a member
+    parked in `draining` that never left the fleet. A `preempt_notice`
+    must be followed by a scale_down start for the same replica — a
+    notice with no retire means the reclamation window lapsed with the
+    member still serving. Resolutions with no start in the window are
+    tolerated (ring tails); the pairing binds on full spills."""
+    open_scales: dict = {}   # (direction, replica) -> seq of the start
+    notices: dict = {}       # replica -> seq of an unresolved notice
+    bad: List[str] = []
+    for r in records:
+        kind = r.get("kind")
+        rep = r.get("replica")
+        if kind == "preempt_notice":
+            notices[rep] = r.get("seq", "?")
+            continue
+        if kind not in ("scale_up", "scale_down"):
+            continue
+        phase = r.get("phase")
+        key = (kind, rep)
+        if phase == "start":
+            prev = open_scales.get(key)
+            if prev is not None:
+                bad.append(
+                    f"replica {rep} {kind} started at seq "
+                    f"{r.get('seq', '?')} while the start at seq {prev} "
+                    "was never resolved (one scale op at a time)")
+            open_scales[key] = r.get("seq", "?")
+            if kind == "scale_down":
+                notices.pop(rep, None)
+        elif phase in ("done", "aborted"):
+            open_scales.pop(key, None)
+    bad += [
+        f"replica {rep} {kind} UNRESOLVED: start at seq {seq} never "
+        "reached done/aborted by journal end"
+        for (kind, rep), seq in sorted(open_scales.items(),
+                                       key=lambda kv: str(kv[0]))
+    ]
+    bad += [
+        f"replica {rep} preemption UNRESOLVED: preempt_notice at seq "
+        f"{seq} never followed by a scale_down (the termination notice "
+        "lapsed with the member still in the fleet)"
+        for rep, seq in sorted(notices.items())
+    ]
+    return bad
+
+
 def check_stream_attribution(records: List[dict]) -> List[str]:
     """Every stream a recovery touched must reach exactly ONE terminal:
     a failed-over/migrated/WAL-recovered stream with two `finish`
@@ -675,6 +726,9 @@ def check_files(paths: List[str]) -> Tuple[List[str], int]:
             records, starve_after=None if sampled else STARVATION_BATCHES)]
         if any(r.get("kind") == "tier_regroup" for r in records):
             bad += [tag + v for v in check_regroup_pairing(records)]
+        if any(r.get("kind") in ("scale_up", "scale_down",
+                                 "preempt_notice") for r in records):
+            bad += [tag + v for v in check_scale_pairing(records)]
         if not any(r.get("kind", "").startswith(("replica_", "migrate_",
                                                  "recover_"))
                    for r in records):

@@ -116,6 +116,13 @@ _MINIMAL = {
                            pages=3, bytes=4096),
     "migrate_abort": dict(replica="r1", to_replica="r0",
                           why="transfer_failed"),
+    "scale_up": dict(replica="a0", phase="done", tier="bulk", why="wake",
+                     burn=0.0, queued=3, fleet=2, spawn_ms=412.0),
+    "scale_down": dict(replica="r1", phase="start", tier="bulk",
+                       why="idle", burn=0.0, queued=0, fleet=2,
+                       inflight=1),
+    "preempt_notice": dict(replica="r1", tier="bulk", notice_s=30.0,
+                           why="fault_plan", inflight=1),
     "wal_admit": dict(fsync_ms=1.25, n_prompt=16),
     "recover_replay": dict(tokens=5, outcome="replayed", n_prompt=16,
                            wal_rid=3),

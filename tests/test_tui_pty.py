@@ -323,6 +323,37 @@ def test_tui_router_overhead_chip_via_pty(tmp_path):
         t.close()
 
 
+# Engine stub shaped like an elastic fleet router: the autoscaler's
+# brief() feeds the fleet-size chip (`fleet N (+P preemptible)` with the
+# scaler's [min..max] band).
+_CHILD_FLEET_SIZE = _CHILD.replace(
+    'eng.runtimes = {}\nadmin_tui.run_tui(eng, None, refresh_ms=50)',
+    '''eng.runtimes = {}
+class _Scaler:
+    def brief(self):
+        return {"n": 3, "preemptible": 1, "min": 1, "max": 4}
+eng.autoscaler = _Scaler()
+eng.fleet_counts = lambda: {"healthy": 3, "ejected": 0, "draining": 0}
+admin_tui.run_tui(eng, None, refresh_ms=50)''')
+assert _CHILD_FLEET_SIZE != _CHILD, "fleet-size child patch failed to apply"
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="pty/termios test")
+def test_tui_fleet_size_chip_via_pty(tmp_path):
+    """Elastic-fleet TUI: the fleet-size chip renders the current size,
+    the preemptible count, and the autoscaler's [min..max] band in the
+    rendered frames."""
+    t = _PtyTui(tmp_path, child_src=_CHILD_FLEET_SIZE)
+    try:
+        assert t.wait_output(b"replicas 3 healthy"), _stderr(t)
+        assert t.wait_output(b"fleet 3 (+1 preemptible)  [1..4]"), _stderr(t)
+        t.send("q")
+        assert t.wait_output(b"TUI_EXIT_OK"), _stderr(t)
+        assert t.proc.wait(timeout=30) == 0
+    finally:
+        t.close()
+
+
 @pytest.mark.skipif(sys.platform != "linux", reason="pty/termios test")
 def test_tui_no_alerts_renders_quiet_panel(tmp_path):
     """Without an alert table (or with it empty) the ALERTS section still
