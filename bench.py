@@ -311,6 +311,23 @@ def main() -> int:
                         "byte-identical to the golden run, and the "
                         "fleet-wide journal audit clean across router + "
                         "member spills; 0 disables")
+    p.add_argument("--router-ha", type=int, default=6,
+                   help="streams in the router_ha scenario: real server "
+                        "subprocesses — an HA primary router (--ha, WAL "
+                        "on) + a warm standby (--standby-of) over two "
+                        "HTTP member services; mid-decode kill -9 of the "
+                        "PRIMARY, the standby replays the shipped "
+                        "WAL/journal into a promotion (epoch bump, "
+                        "member re-registration, WAL re-admission) and "
+                        "clients reconnect to the STANDBY via GET "
+                        "/api/stream/{req_id}?from=N; the dead primary "
+                        "is then revived and must be FENCED (members "
+                        "409 its stale epoch) — gated on 0 dropped "
+                        "streams, 0 silent truncations, byte-identical "
+                        "resumed streams vs the golden run, >=1 fenced "
+                        "call, and the multi-spill journal audit "
+                        "(takeover pairing + epoch monotonicity) clean; "
+                        "0 disables")
     p.add_argument("--cpu", action="store_true",
                    help="force the CPU platform (smoke-testing the harness)")
     p.add_argument("--init-timeout", type=float, default=300.0,
@@ -868,6 +885,21 @@ def main() -> int:
             print(f"# crash_restart scenario failed: "
                   f"{crash_restart['error']}", file=sys.stderr)
 
+    # router_ha scenario: real subprocess servers again — an HA primary
+    # (replication stream on) with a warm standby tailing it; kill -9
+    # the primary mid-decode, the standby promotes (epoch bump + member
+    # re-registration + WAL re-admission), clients resume against the
+    # standby byte-identically, and the revived zombie primary is fenced
+    # by every member. The ROADMAP item-3 closer.
+    router_ha = None
+    if args.router_ha > 0:
+        try:
+            router_ha = _router_ha_scenario(args, touch)
+        except Exception as e:  # never discard the decode numbers
+            router_ha = {"error": f"{type(e).__name__}: {e}"}
+            print(f"# router_ha scenario failed: {router_ha['error']}",
+                  file=sys.stderr)
+
     result = {
         "metric": "decode_tok_per_s_per_chip",
         "value": round(tok_per_s, 1),
@@ -938,6 +970,8 @@ def main() -> int:
         result["diurnal"] = diurnal
     if crash_restart is not None:
         result["crash_restart"] = crash_restart
+    if router_ha is not None:
+        result["router_ha"] = router_ha
     run_done.set()
     print(json.dumps(result), flush=True)
     return 0
@@ -2124,6 +2158,364 @@ def _crash_restart_scenario(args, touch):
             "pass": bool(golden_ok and dropped == 0 and silent == 0
                          and not mismatches and recovered > 0
                          and id_exact and not violations),
+        }
+    finally:
+        for p in procs:
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                p._logf.close()
+            except Exception:  # noqa: BLE001
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _router_ha_scenario(args, touch):
+    """Router-HA acceptance at the PROCESS level: an HA primary router
+    (admission WAL + journal tap replicated over /admin/ha/sync) and a
+    warm standby tailing it, over two HTTP member services. One seeded
+    trace, two legs:
+
+      golden leg  N streams through the primary, untouched.
+      chaos leg   the same N streams; mid-decode, `kill -9` the
+                  PRIMARY. The standby detects heartbeat loss past the
+                  takeover grace, promotes — epoch bump, member
+                  re-registration, WAL-replica re-admission — and each
+                  client reconnects TO THE STANDBY with
+                  GET /api/stream/{rid}?from=N for the remainder.
+
+    Then the dead primary is REVIVED on its old WAL dir: its recovery
+    replays the same streams at the stale epoch and every member must
+    fence it (409 + epoch_fence journaled) — zero stale-epoch
+    placements accepted, while a fresh stream through the promoted
+    standby still completes. Gates: dropped_streams == 0,
+    silent_truncations == 0, every resumed stream byte-identical to
+    its golden twin, the standby 503s (with Retry-After) before
+    promotion, >= 1 fenced call after revival, and the multi-spill
+    journal audit — primary spill, standby spill (takeover pairing +
+    epoch monotonicity bind here), the standby's primary-journal
+    replica, and both member spills — clean."""
+    import json as _json
+    import shutil
+    import socket
+    import subprocess
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from ollamamq_tpu.tools.journal import check_files
+    from ollamamq_tpu.telemetry.journal import load_jsonl
+
+    n = args.router_ha
+    max_new = 14  # under the fake runtime's 16-token ceiling
+    golden_text = "".join(f"word{i} " for i in range(max_new))
+    tmp = tempfile.mkdtemp(prefix="ollamamq-ha-")
+    wal_p = os.path.join(tmp, "wal-primary")
+    wal_s = os.path.join(tmp, "wal-standby")
+    procs = []
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def spawn(argv, log_name):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["FAKE_TOKEN_LATENCY_S"] = "0.05"
+        logf = open(os.path.join(tmp, log_name), "wb")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "ollamamq_tpu.cli"] + argv,
+            stdout=logf, stderr=subprocess.STDOUT, env=env)
+        p._logf = logf
+        procs.append(p)
+        return p
+
+    def get_health(port, timeout=2.0):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health", timeout=timeout) as r:
+            return _json.loads(r.read())
+
+    def wait_health(port, budget=90.0, ok=None):
+        """Poll /health until `ok(body)` (default: not recovering)."""
+        if ok is None:
+            ok = lambda b: b.get("status") != "recovering"  # noqa: E731
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            try:
+                body = get_health(port)
+                if ok(body):
+                    return body
+            except Exception:  # noqa: BLE001
+                pass
+            touch("router_ha")
+            time.sleep(0.2)
+        raise RuntimeError(f"server on :{port} never became healthy")
+
+    def prom_counter(port, name):
+        """Sum a counter across its label rows off /metrics; None if
+        the metric never fired (no rows exported)."""
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        total, found = 0.0, False
+        for line in text.splitlines():
+            if line.startswith(name) and " " in line:
+                try:
+                    total += float(line.rsplit(" ", 1)[1])
+                    found = True
+                except ValueError:
+                    pass
+        return total if found else None
+
+    class Client:
+        """One NDJSON stream: records frames + token ids, notes its
+        req_id, survives the router dying mid-read (resume() collects
+        the remainder — possibly from a DIFFERENT router port)."""
+
+        def __init__(self, port, user, prompt):
+            self.port = port
+            self.user = user
+            self.prompt = prompt
+            self.rid = None
+            self.text = ""
+            self.ids = []
+            self.done_reason = None
+            self.thread = threading.Thread(target=self._run, daemon=True)
+            self.thread.start()
+
+        def _consume(self, resp):
+            for raw in resp:
+                obj = _json.loads(raw)
+                if obj.get("req_id") is not None:
+                    self.rid = int(obj["req_id"])
+                self.ids.extend(int(t) for t in obj.get("token_ids") or ())
+                self.text += obj.get("response", "")
+                if obj.get("done"):
+                    self.done_reason = obj.get("done_reason", "stop")
+                    return
+
+        def _run(self):
+            body = _json.dumps({
+                "model": "test-tiny", "prompt": self.prompt,
+                "stream": True, "options": {"num_predict": max_new}})
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{self.port}/api/generate",
+                data=body.encode(),
+                headers={"Content-Type": "application/json",
+                         "X-User-ID": self.user}, method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    self._consume(resp)
+            except Exception:  # noqa: BLE001 — the primary died under us
+                pass
+
+        def resume(self):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{self.port}/api/stream/{self.rid}"
+                f"?from={len(self.ids)}",
+                headers={"X-User-ID": self.user}, method="GET")
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                self._consume(resp)
+
+    # -- topology ----------------------------------------------------------
+    ports = {"a": free_port(), "b": free_port(),
+             "primary": free_port(), "standby": free_port()}
+    member_argv = ["--fake-engine", "--no-tui", "--models", "test-tiny",
+                   "--blocklist", os.path.join(tmp, "bl.json")]
+    spawn(member_argv + ["--port", str(ports["a"]),
+                         "--journal-file", os.path.join(tmp, "ma.jsonl")],
+          "member_a.log")
+    spawn(member_argv + ["--port", str(ports["b"]),
+                         "--journal-file", os.path.join(tmp, "mb.jsonl")],
+          "member_b.log")
+    replica_urls = (f"http://127.0.0.1:{ports['a']},"
+                    f"http://127.0.0.1:{ports['b']}")
+
+    def primary_argv(journal_tag=""):
+        return ["--fake-engine", "--no-tui", "--models", "test-tiny",
+                "--port", str(ports["primary"]),
+                "--replicas", "0", "--replica-urls", replica_urls,
+                "--ha", "--takeover-grace-s", "1.0",
+                "--wal-dir", wal_p, "--wal-fsync-ms", "5",
+                "--journal-file",
+                os.path.join(tmp, f"router-primary{journal_tag}.jsonl"),
+                "--blocklist", os.path.join(tmp, "bl.json")]
+
+    standby_argv = [
+        "--fake-engine", "--no-tui", "--models", "test-tiny",
+        "--port", str(ports["standby"]),
+        "--replicas", "0", "--replica-urls", replica_urls,
+        "--standby-of", f"http://127.0.0.1:{ports['primary']}",
+        "--takeover-grace-s", "1.0",
+        "--wal-dir", wal_s, "--wal-fsync-ms", "5",
+        "--journal-file", os.path.join(tmp, "standby.jsonl"),
+        "--blocklist", os.path.join(tmp, "bl.json")]
+
+    try:
+        wait_health(ports["a"])
+        wait_health(ports["b"])
+        primary = spawn(primary_argv(), "primary.log")
+        wait_health(ports["primary"])
+        standby = spawn(standby_argv, "standby.log")
+        # Standby is healthy once it reports its role AND has applied
+        # the cold snapshot (lag 0 against an idle primary).
+        wait_health(ports["standby"],
+                    ok=lambda b: b.get("role") == "standby"
+                    and b.get("sync_lag_records") == 0)
+
+        # -- golden leg (through the primary, untouched) -------------------
+        golden = [Client(ports["primary"], f"ha{i % 4}", f"router ha {i}")
+                  for i in range(n)]
+        for c in golden:
+            c.thread.join(timeout=120)
+        golden_ok = all(c.text == golden_text for c in golden)
+
+        # -- standby never serves pre-promotion ----------------------------
+        standby_503 = False
+        retry_after = None
+        try:
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{ports['standby']}/api/generate",
+                data=_json.dumps({"model": "test-tiny", "prompt": "x",
+                                  "stream": False}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST"), timeout=10)
+        except urllib.error.HTTPError as e:
+            standby_503 = e.code in (429, 503)
+            retry_after = e.headers.get("Retry-After")
+
+        # -- chaos leg: kill -9 the primary mid-decode ---------------------
+        clients = [Client(ports["primary"], f"ha{i % 4}", f"router ha {i}")
+                   for i in range(n)]
+        deadline = time.monotonic() + 120.0
+        killed_at = None
+        pre_kill_lag = None
+        while time.monotonic() < deadline:
+            touch("router_ha")
+            tokens = sum(len(c.ids) for c in clients)
+            if tokens >= 4 * n and all(c.rid is not None for c in clients):
+                try:  # standby's replication position just before the cut
+                    pre_kill_lag = get_health(
+                        ports["standby"]).get("sync_lag_records")
+                except Exception:  # noqa: BLE001
+                    pass
+                primary.kill()  # SIGKILL: no drain, no handover
+                killed_at = time.monotonic()
+                break
+            if all(c.done_reason is not None for c in clients):
+                break
+            time.sleep(0.05)
+        if killed_at is None:
+            raise RuntimeError("streams finished before the kill point")
+        for c in clients:
+            c.thread.join(timeout=30)  # readers die with the primary
+
+        # Promotion: role flips standby -> (promoting) -> primary, and
+        # the WAL replay must be done before clients resume.
+        wait_health(ports["standby"], budget=60.0,
+                    ok=lambda b: b.get("role") == "primary"
+                    and b.get("status") != "recovering")
+        takeover_observed_ms = round((time.monotonic() - killed_at) * 1e3)
+        for c in clients:
+            if c.done_reason is None and c.rid is not None:
+                c.port = ports["standby"]
+                c.resume()
+
+        # -- revive the zombie primary: every member must fence it --------
+        zombie = spawn(primary_argv(journal_tag="-zombie"), "zombie.log")
+        time.sleep(3.0)  # register + WAL recovery placements, all fenced
+        touch("router_ha")
+        fenced = sum(
+            prom_counter(ports[m], "ollamamq_ha_fenced_calls_total") or 0
+            for m in ("a", "b"))
+        # The promoted router must still place fresh work while the
+        # zombie is being turned away.
+        probe = Client(ports["standby"], "ha-probe", "post takeover")
+        probe.thread.join(timeout=60)
+        post_ok = probe.text == golden_text
+
+        # -- scoring -------------------------------------------------------
+        dropped = sum(1 for c in clients if c.done_reason is None)
+        mismatches = [i for i, c in enumerate(clients)
+                      if c.text != golden_text]
+        silent = sum(1 for i in mismatches
+                     if golden_text.startswith(clients[i].text)
+                     and clients[i].done_reason in ("stop", "length"))
+        id_exact = all(c.ids == list(range(1, max_new + 1))
+                       for c in clients if c.done_reason)
+
+        # Graceful close of the promoted standby flushes its spill (its
+        # handover attempt no-ops: nobody tails it). The zombie is
+        # killed hard — its spill stays out of the audit below.
+        zombie.kill()
+        standby.send_signal(15)
+        try:
+            standby.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            standby.kill()
+        # Multi-spill audit as ONE run: the dead primary's spill, the
+        # standby's spill (router_takeover pairing + epoch monotonicity
+        # bind here), the standby's primary-journal replica (byte copy,
+        # journal_meta replica_of excludes it from the cross-spill
+        # duplicate-epoch check), and both member spills (epoch_fence
+        # sanity binds there). The ZOMBIE's spill is excluded by
+        # design: its recovery replays streams other spills already
+        # resolved, at an epoch the fleet fenced — it is not part of
+        # the surviving run.
+        spills = [p for p in
+                  (os.path.join(tmp, "router-primary.jsonl"),
+                   os.path.join(tmp, "standby.jsonl"),
+                   os.path.join(wal_s, "primary-journal.jsonl"),
+                   os.path.join(tmp, "ma.jsonl"),
+                   os.path.join(tmp, "mb.jsonl"))
+                  if os.path.exists(p)]
+        violations, audited = check_files(spills)
+        takeover_ms = None
+        new_epoch = None
+        try:
+            _, srecs = load_jsonl(os.path.join(tmp, "standby.jsonl"))
+            for r in srecs:
+                if r.get("kind") == "router_takeover" \
+                        and r.get("phase") == "done":
+                    takeover_ms = r.get("takeover_ms")
+                    new_epoch = r.get("epoch")
+        except Exception:  # noqa: BLE001 — readout only, never the gate
+            pass
+        return {
+            "requests": n,
+            "max_new_tokens": max_new,
+            "takeover_ms": takeover_ms,
+            "takeover_observed_ms": takeover_observed_ms,
+            "epoch_after_takeover": new_epoch,
+            "pre_kill_sync_lag_records": pre_kill_lag,
+            "standby_shed_pre_promotion": standby_503,
+            "standby_retry_after_s": retry_after,
+            "fenced_calls": fenced,
+            "post_takeover_stream_ok": post_ok,
+            "dropped_streams": dropped,
+            "silent_truncations": silent,
+            "stream_mismatches": len(mismatches),
+            "resumed_streams": sum(1 for c in clients
+                                   if c.rid is not None
+                                   and c.done_reason is not None),
+            "token_exact": id_exact,
+            "golden_leg_ok": golden_ok,
+            "journal_spills_audited": len(spills),
+            "journal_records_audited": audited,
+            "invariant_violations": len(violations),
+            "violations_sample": violations[:5],
+            "pass": bool(golden_ok and dropped == 0 and silent == 0
+                         and not mismatches and id_exact
+                         and standby_503 and retry_after is not None
+                         and fenced >= 1 and post_ok
+                         and takeover_observed_ms < 60_000
+                         and not violations),
         }
     finally:
         for p in procs:

@@ -449,6 +449,27 @@ struct Tui {
                       fmax);
       out.push_back(std::string(CYAN) + l + RST);
     }
+    /* HA role chip (HA fleets only): role + fencing epoch, e.g.
+     * "ha primary/3"; a standby adds its replication lag in records.
+     * RED while "promoting" (takeover ladder in flight) and for a
+     * standby that has not caught up to its primary's stream — in both
+     * states the fleet is one failure away from dropping streams. */
+    auto ha = stats->get("ha");
+    if (ha && ha->type == mj::Value::OBJ) {
+      std::string role = ha->get("role") ? ha->get("role")->str : "?";
+      long long epoch = ha->get("epoch") ? ha->get("epoch")->as_int() : 0;
+      bool synced = !ha->get("synced") ||
+                    ha->get("synced")->type != mj::Value::BOOL ||
+                    ha->get("synced")->b;
+      auto lag = ha->get("lag");
+      if (role != "primary" && lag && lag->type == mj::Value::NUM)
+        std::snprintf(l, sizeof l, " ha %s/%lld  lag %.0f", role.c_str(),
+                      epoch, lag->as_num());
+      else
+        std::snprintf(l, sizeof l, " ha %s/%lld", role.c_str(), epoch);
+      bool alarm = role == "promoting" || (role == "standby" && !synced);
+      out.push_back(std::string(alarm ? RED : CYAN) + l + RST);
+    }
     /* Tiers line (tiered fleets only): healthy/total per replica tier.
      * RED when any tier has ZERO healthy members — that tier's traffic
      * is being served cross-tier (journaled overflow) until a member

@@ -33,6 +33,9 @@ set -- --no-tui --host 0.0.0.0
 [ -n "${PREEMPTIBLE:-}" ] && set -- "$@" --preemptible "$PREEMPTIBLE"
 [ "${FEDERATE_METRICS:-}" = "false" ] && set -- "$@" --no-federate-metrics
 [ -n "${MAX_SLOTS:-}" ] && set -- "$@" --max-slots "$MAX_SLOTS"
+[ "${HA:-}" = "true" ] && set -- "$@" --ha
+[ -n "${STANDBY_OF:-}" ] && set -- "$@" --standby-of "$STANDBY_OF"
+[ -n "${TAKEOVER_GRACE_S:-}" ] && set -- "$@" --takeover-grace-s "$TAKEOVER_GRACE_S"
 [ -n "${WAL_DIR:-}" ] && set -- "$@" --wal-dir "$WAL_DIR"
 [ -n "${WAL_FSYNC_MS:-}" ] && set -- "$@" --wal-fsync-ms "$WAL_FSYNC_MS"
 [ -n "${JOURNAL_SAMPLE:-}" ] && set -- "$@" --journal-sample "$JOURNAL_SAMPLE"

@@ -136,6 +136,20 @@ def _engine_stats_brief(engine) -> dict:
             }
         except Exception:
             pass
+    # HA role chip (HA fleets only): `ha primary/3` = role + fencing
+    # epoch. The C++ side renders it red while "promoting" (takeover in
+    # flight) and for a standby that has lost its primary feed.
+    ha_fn = getattr(engine, "ha_status", None)
+    if ha_fn is not None:
+        try:
+            hs = ha_fn()
+            if hs is not None:
+                out["ha"] = {"role": hs.get("role", "?"),
+                             "epoch": hs.get("epoch", 0),
+                             "lag": hs.get("sync_lag_records"),
+                             "synced": hs.get("synced", True)}
+        except Exception:
+            pass
     # Tiers line (tiered fleets only): healthy/total per tier — the C++
     # side renders it red when any tier has ZERO healthy members (that
     # tier's traffic is running cross-tier until a member heals in).

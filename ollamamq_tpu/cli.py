@@ -229,6 +229,33 @@ def build_parser() -> argparse.ArgumentParser:
                         "monitor fires the router_overhead alert and "
                         "the bench fleet-chaos gate fails. 0 disables "
                         "the alert (the timers stay on)")
+    # Router HA (fleet/ha.py): warm-standby router with epoch fencing.
+    p.add_argument("--ha", action="store_true",
+                   default=os.environ.get("HA", "").lower()
+                   in ("1", "true", "yes"),
+                   help="run this fleet router as the HA PRIMARY: expose "
+                        "the replication stream (GET /admin/ha/sync — "
+                        "WAL records + decision-journal events + shadow "
+                        "placement state) a --standby-of router tails, "
+                        "stamp every member-facing call with the router "
+                        "epoch, and on SIGTERM hand the fleet to the "
+                        "caught-up standby instead of draining. "
+                        "Requires --wal-dir and a fleet")
+    p.add_argument("--standby-of", default=os.environ.get("STANDBY_OF", ""),
+                   help="run as the warm STANDBY of the primary router at "
+                        "this base URL: tail its replication stream into "
+                        "local WAL/journal replicas, shed clients with "
+                        "503 + Retry-After meanwhile, and after "
+                        "--takeover-grace-s of heartbeat loss PROMOTE — "
+                        "bump the epoch (fencing the old primary if it "
+                        "revives), re-register the members, replay every "
+                        "unfinished stream through recovery, then serve. "
+                        "Requires --wal-dir and --replica-urls naming "
+                        "the same members the primary serves")
+    p.add_argument("--takeover-grace-s", type=float,
+                   default=float(os.environ.get("TAKEOVER_GRACE_S", 3.0)),
+                   help="standby heartbeat-loss grace before promotion; "
+                        "sync polls run at grace/4 (floored at 50ms)")
     p.add_argument("--no-federate-metrics", action="store_true",
                    default=os.environ.get("FEDERATE_METRICS", "").lower()
                    in ("0", "false", "no"),
@@ -432,6 +459,20 @@ def install_graceful_shutdown(engine, grace_s: float) -> None:
     fired = threading.Event()
 
     def run(signum: int) -> None:
+        # HA primary: hand the fleet to the caught-up standby (it
+        # promotes with why="handover") instead of draining the world.
+        # ha_handover quiesces first either way; False (no standby, or
+        # it never confirmed) falls through to the normal drain below.
+        handover = getattr(engine, "ha_handover", None)
+        if handover is not None:
+            try:
+                if handover(timeout_s=min(10.0, max(1.0, grace_s))):
+                    log.warning("signal %d: fleet handed over to the "
+                                "standby; exiting 0", signum)
+                    engine.stop()
+                    os._exit(0)
+            except Exception:  # noqa: BLE001
+                log.exception("HA handover failed; draining instead")
         log.warning("signal %d: graceful shutdown — admission stopped, "
                     "draining in-flight work (grace %.0fs)",
                     signum, grace_s)
@@ -548,6 +589,24 @@ def main(argv=None) -> int:
         if scale_err is not None:
             log.error("%s", scale_err)
             return 2
+    # HA knobs fail fast BEFORE any device work — argparse doesn't
+    # validate env-supplied defaults (HA/STANDBY_OF/TAKEOVER_GRACE_S),
+    # so a bad compose file must die here, not at the first heartbeat.
+    from ollamamq_tpu.config import validate_ha
+
+    ha_err = validate_ha(args.ha, args.standby_of or None,
+                         args.takeover_grace_s,
+                         (None if args.no_wal else (args.wal_dir or None)),
+                         args.replica_urls or None)
+    if ha_err is not None:
+        log.error("%s", ha_err)
+        return 2
+    if args.ha and args.replicas <= 1 and not fleet_urls \
+            and not args.autoscale:
+        log.error("--ha needs a fleet (--replicas > 1, --replica-urls, "
+                  "or --autoscale): the standby re-registers those "
+                  "members at takeover")
+        return 2
     if args.preemptible:
         want = [s.strip() for s in args.preemptible.split(",")
                 if s.strip()]
@@ -680,6 +739,9 @@ def main(argv=None) -> int:
         journal_sample=args.journal_sample,
         wal_dir=(None if args.no_wal else (args.wal_dir or None)),
         wal_fsync_ms=args.wal_fsync_ms,
+        ha=args.ha,
+        standby_of=args.standby_of or None,
+        takeover_grace_s=args.takeover_grace_s,
         weights_dtype=args.weights_dtype,
         kv_dtype=args.kv_dtype,
         replicas=args.replicas,
@@ -698,6 +760,7 @@ def main(argv=None) -> int:
     )
     fairness = Fairness.TOKENS if args.token_fairness else Fairness.REQUESTS
 
+    standby = None
     if args.spmd and args.fake_engine:
         log.error("--spmd and --fake-engine are mutually exclusive")
         return 2
@@ -720,7 +783,7 @@ def main(argv=None) -> int:
         # and double-recover every stream).
         member_cfg = dataclasses.replace(
             ecfg, max_queued=0, max_queued_per_user=0, journal_file=None,
-            wal_dir=None, tiers=None)
+            wal_dir=None, tiers=None, ha=False, standby_of=None)
         # Tiered fleets: members assigned to a tier that declares an
         # @tpN width START at that width; the same factory rebuilds a
         # member at a new width when the TierBalancer regroups it.
@@ -791,11 +854,21 @@ def main(argv=None) -> int:
 
                 provisioner = LocalProvisioner(
                     _member_factory(member_cfg))
+        # A standby's router must not attach a primary-side coordinator
+        # at construction — it becomes one only at promotion.
+        router_cfg = (dataclasses.replace(ecfg, ha=False)
+                      if args.standby_of else ecfg)
         engine = FleetRouter(
-            members, ecfg, blocklist_path=args.blocklist,
+            members, router_cfg, blocklist_path=args.blocklist,
             fairness=fairness, placement=args.placement,
             drain_timeout_s=args.drain_timeout_s,
             provisioner=provisioner)
+        if args.standby_of:
+            from ollamamq_tpu.fleet.ha import HAStandby
+
+            standby = HAStandby(engine, args.standby_of)
+            engine.ha = standby
+            engine.accepting = False  # shed until promotion opens the gate
     elif args.spmd:
         import jax
 
@@ -832,7 +905,16 @@ def main(argv=None) -> int:
 
         engine = TPUEngine(ecfg, models=models, blocklist_path=args.blocklist,
                            fairness=fairness)
-    engine.start()
+    if standby is not None:
+        # The standby's router stays UNSTARTED until promotion — no
+        # member probes, no placements, just the replication tail.
+        # Clients shed with 503 + Retry-After (takeover-cost EMA).
+        standby.start()
+        log.warning("warm standby: tailing primary %s "
+                    "(takeover grace %.1fs)",
+                    args.standby_of, args.takeover_grace_s)
+    else:
+        engine.start()
 
     from ollamamq_tpu.server.app import Server
 

@@ -111,6 +111,20 @@ class RequestWAL:
         self._flusher: Optional[threading.Thread] = None
         self.bytes_written = 0
         self.fsyncs = 0
+        # Optional replication mirror (fleet/ha.py): called with each
+        # record dict as it is buffered, so a warm standby's WAL replica
+        # tracks this one within a sync batch. Exceptions are contained
+        # — replication trouble must not break the durability ACK path.
+        self.mirror = None
+
+    def _mirrored(self, rec: dict) -> dict:
+        m = self.mirror
+        if m is not None:
+            try:
+                m(rec)
+            except Exception:  # noqa: BLE001
+                pass
+        return rec
 
     # -- lifecycle ---------------------------------------------------------
     def read_existing(self) -> Tuple[Dict[int, dict], int]:
@@ -175,7 +189,7 @@ class RequestWAL:
             return 0.0
         t0 = time.monotonic()
         with self._cond:
-            self._buf.append(json.dumps(rec))
+            self._buf.append(json.dumps(self._mirrored(rec)))
             self._appended += 1
             target = self._appended
             if self._fh is None:
@@ -197,17 +211,39 @@ class RequestWAL:
         if self.dead or not items:
             return
         with self._lock:
-            self._buf.append(json.dumps({"k": "tok", "rid": rid,
-                                         "items": items}))
+            self._buf.append(json.dumps(self._mirrored(
+                {"k": "tok", "rid": rid, "items": items})))
             self._appended += 1
 
     def finish(self, rid: int, reason: str) -> None:
         if self.dead:
             return
         with self._lock:
-            self._buf.append(json.dumps({"k": "fin", "rid": rid,
-                                         "reason": reason}))
+            self._buf.append(json.dumps(self._mirrored(
+                {"k": "fin", "rid": rid, "reason": reason})))
             self._appended += 1
+
+    def snapshot_lines(self, mark=None) -> List[str]:
+        """(HA cold catch-up) Flush everything buffered, then return the
+        current generation's raw JSONL lines. `mark` (optional callback)
+        runs UNDER the WAL lock between the flush and the read: mirror
+        calls also hold this lock, so a replication head captured there
+        is exactly the snapshot's edge — records after the mark are in
+        the ring, records at or before it are in these lines, never
+        both."""
+        with self._cond:
+            self._flush_locked()
+            if mark is not None:
+                mark()
+            lines: List[str] = []
+            try:
+                if os.path.exists(self.path):
+                    with open(self.path, encoding="utf-8") as f:
+                        lines = [ln.rstrip("\n") for ln in f
+                                 if ln.strip()]
+            except OSError:
+                lines = []
+            return lines
 
     # -- flusher -----------------------------------------------------------
     def _flush_locked(self) -> None:

@@ -31,6 +31,7 @@ import json
 import logging
 import threading
 import time
+import urllib.error
 import urllib.request
 from typing import Optional
 
@@ -158,9 +159,28 @@ class _MemberBase:
         # retire can tear down what provision built (a subprocess, a
         # cloud VM) — operator-defined members have None and just stop.
         self.provisioned_by = None
+        # Router HA (fleet/ha.py): the fencing epoch every member-facing
+        # call carries (X-Router-Epoch). None = HA off, no header, the
+        # member-side check passes — non-HA fleets are unchanged.
+        self.router_epoch: Optional[int] = None
+        # Set when this member 409'd a call carrying OUR epoch: a newer
+        # router registered a higher one, i.e. WE are the zombie. A
+        # fenced member fails streams terminally instead of feeding the
+        # failover loop — without this a revived dead primary retries
+        # every rejected placement forever (a 409 storm against the
+        # whole fleet).
+        self.fenced = False
 
     def force_stale(self, delay_s: float) -> None:
         self.forced_stale_until = time.monotonic() + float(delay_s)
+
+    def register(self, epoch: int) -> bool:
+        """Adopt a (new) router epoch. In-process members need no wire
+        fencing — a LocalMember dies with its router, so a zombie
+        primary can never reach it; HttpMember overrides this with the
+        /admin/ha/register POST."""
+        self.router_epoch = int(epoch)
+        return True
 
     # -- fleet observability (overridden per shape) ------------------------
     def trace_spans(self, ctx: str) -> list:
@@ -525,6 +545,31 @@ class HttpMember(_MemberBase):
             except Exception:  # noqa: BLE001
                 pass
 
+    # -- router HA ---------------------------------------------------------
+    def _epoch_headers(self, headers: dict) -> dict:
+        if self.router_epoch is not None:
+            headers["X-Router-Epoch"] = str(self.router_epoch)
+        return headers
+
+    def register(self, epoch: int) -> bool:
+        """Re-register this member under a (new) router epoch: the
+        member adopts the highest epoch it has seen and fences every
+        later call carrying a lower one. Returns False when the member
+        rejected US as stale (a newer router already registered) or is
+        unreachable — the caller decides whether that is fatal."""
+        self.router_epoch = int(epoch)
+        try:
+            self._post_json("/admin/ha/register",
+                            {"epoch": int(epoch)}, timeout=5.0).close()
+            self.fenced = False
+            return True
+        except urllib.error.HTTPError as e:
+            if e.code == 409:
+                self.fenced = True  # a newer epoch holds this member
+            return False
+        except Exception:  # noqa: BLE001 — down members re-register on
+            return False    # the next placement's begin()
+
     # -- health ------------------------------------------------------------
     def alive(self) -> bool:
         return not self._forced_down
@@ -644,8 +689,9 @@ class HttpMember(_MemberBase):
                 httpreq = urllib.request.Request(
                     self.url + "/api/embed",
                     data=json.dumps(body).encode(),
-                    headers={"Content-Type": "application/json",
-                             "X-User-ID": flight.user}, method="POST")
+                    headers=self._epoch_headers(
+                        {"Content-Type": "application/json",
+                         "X-User-ID": flight.user}), method="POST")
                 with urllib.request.urlopen(httpreq,
                                             timeout=self.timeout_s) as resp:
                     out = json.loads(resp.read())
@@ -673,7 +719,7 @@ class HttpMember(_MemberBase):
                 httpreq = urllib.request.Request(
                     self.url + "/api/generate",
                     data=json.dumps(body).encode(),
-                    headers=headers, method="POST")
+                    headers=self._epoch_headers(headers), method="POST")
                 att.resp = urllib.request.urlopen(httpreq,
                                                   timeout=self.timeout_s)
             for raw in att.resp:
@@ -710,6 +756,22 @@ class HttpMember(_MemberBase):
             # Stream ended without a done line: the member died mid-write.
             att.transport_dead = True
         except Exception as e:  # noqa: BLE001
+            if (isinstance(e, urllib.error.HTTPError) and e.code == 409
+                    and self.router_epoch is not None and not att.closed):
+                # Stale-epoch fence: the member rejected OUR epoch — a
+                # newer router owns the fleet. Terminal, not a failover
+                # trigger: re-dispatching would 409 on every member
+                # until the heat death of the fleet, and the stream is
+                # already being served (or recovered) by the successor.
+                self.fenced = True
+                log.error(
+                    "member %s fenced router epoch %s for req %s: a "
+                    "newer router has taken over; failing the stream "
+                    "instead of retrying", self.name, self.router_epoch,
+                    flight.rid0)
+                stream.push(StreamItem(
+                    "error", finish_reason=FinishReason.ERROR))
+                return
             if not att.closed:
                 log.warning("member %s stream for req %s died: %s",
                             self.name, flight.rid0, e)
@@ -735,7 +797,8 @@ class HttpMember(_MemberBase):
     def _post_json(self, path: str, body: dict, timeout: float):
         httpreq = urllib.request.Request(
             self.url + path, data=json.dumps(body).encode(),
-            headers={"Content-Type": "application/json"}, method="POST")
+            headers=self._epoch_headers(
+                {"Content-Type": "application/json"}), method="POST")
         return urllib.request.urlopen(httpreq, timeout=timeout)
 
     def export_stream(self, att: Attempt,
@@ -801,8 +864,8 @@ class HttpMember(_MemberBase):
             headers["X-Deadline-Ms"] = str(max(1.0, left_ms))
         httpreq = urllib.request.Request(
             self.url + "/admin/migrate/import",
-            data=kvc.pack_migration_blob(blob), headers=headers,
-            method="POST")
+            data=kvc.pack_migration_blob(blob),
+            headers=self._epoch_headers(headers), method="POST")
         att.resp = urllib.request.urlopen(httpreq, timeout=self.timeout_s)
         att.thread = threading.Thread(
             target=self._reader, args=(att, flight, att.base_n),

@@ -288,6 +288,18 @@ class FleetRouter:
                 provisioner = LocalProvisioner(factory)
             self.autoscaler = AutoscalerManager(
                 self, provisioner, **(autoscale_kw or {}))
+        # Router HA (fleet/ha.py): `epoch` stamps every member-facing
+        # call (members adopt newer epochs and fence older ones, so a
+        # zombie ex-primary can't split-brain the fleet). --ha attaches
+        # the primary-side replication coordinator here; a standby
+        # process gets an HAStandby attached by the CLI instead and
+        # stays unstarted until promotion.
+        self.epoch = 1
+        self.ha = None
+        if getattr(engine_cfg, "ha", False):
+            from ollamamq_tpu.fleet.ha import HACoordinator
+
+            self.ha = HACoordinator(self)
         for mem in self.members:
             self.journal.record("replica_join", replica=mem.name,
                                 why="start")
@@ -300,6 +312,9 @@ class FleetRouter:
         self._running = True
         for mem in self.members:
             mem.start()
+        if self.ha is not None and hasattr(self.ha, "on_router_start"):
+            # Stamp every member with our epoch before placements land.
+            self.ha.on_router_start()
         self._thread = threading.Thread(target=self._loop, name="fleet",
                                         daemon=True)
         self._thread.start()
@@ -423,6 +438,22 @@ class FleetRouter:
                 out.append(mem.name)
         return out
 
+    def ha_status(self) -> Optional[dict]:
+        """Role/epoch/sync-lag readout (None = HA off): /health's role
+        block, the TUI ha chip, and the health watchdog's standby-lag /
+        stuck-takeover rules all read this one dict."""
+        return self.ha.status() if self.ha is not None else None
+
+    def ha_handover(self, timeout_s: float = 10.0) -> bool:
+        """Graceful SIGTERM on an HA primary: quiesce, then hand the
+        fleet to the caught-up standby (it promotes with why="handover")
+        instead of draining the world. False = no standby ever synced or
+        it never confirmed — the caller falls back to a normal drain."""
+        if self.ha is None or not hasattr(self.ha, "request_handover"):
+            return False
+        self.quiesce()
+        return self.ha.request_handover(timeout_s)
+
     def preemption_count(self) -> int:
         return sum(mem.engine.preemption_count()
                    for mem in self.local_members)
@@ -491,11 +522,20 @@ class FleetRouter:
         cfg = self.ecfg
         if not self.accepting:
             self._count_shed("queue_full")
+            retry_s = 5.0
+            if self.ha is not None:
+                # Promotion shed: tell clients when the takeover is
+                # EXPECTED to let them in (the measured takeover-cost
+                # EMA), not a blind cold-start clamp.
+                eta = self.ha.promote_eta_s()
+                if eta is not None:
+                    retry_s = eta
             self.journal.record(
                 "shed", user=user, model=model or None, reason="queue_full",
                 queued=self.core.total_queued(), limit=0,
-                retry_after_s=5.0, n_prompt=len(prompt_tokens or []))
-            raise QueueFullError("queue_full", 5.0, 0)
+                retry_after_s=round(retry_s, 3),
+                n_prompt=len(prompt_tokens or []))
+            raise QueueFullError("queue_full", retry_s, 0)
         if cfg.max_queued and self.core.total_queued() >= cfg.max_queued:
             self._count_shed("queue_full")
             retry_s = self.retry_after_s()

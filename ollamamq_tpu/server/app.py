@@ -97,6 +97,13 @@ class Server:
         self.allow_all_routes = allow_all_routes
         self.started_at = time.time()
         self._profiling = False
+        # Router HA epoch fencing (member side): the highest
+        # X-Router-Epoch this member has seen. Any call carrying a
+        # HIGHER epoch adopts it (the new primary owns us even if its
+        # explicit /admin/ha/register never arrived); a LOWER one is a
+        # zombie ex-primary and gets fenced with 409. 0 = HA never seen,
+        # header-less callers always pass.
+        self._ha_epoch = 0
 
     # ------------------------------------------------------------------ app
     def build_app(self) -> web.Application:
@@ -165,6 +172,11 @@ class Server:
             # termination notice -> migrate-off-then-retire.
             r.add_route("POST", "/admin/preempt/{replica}",
                         self.admin_preempt)
+            # Router HA (--ha): the warm standby tails this replication
+            # stream. Registered on every router; the handler answers
+            # 409 unless the engine is an HA primary RIGHT NOW (a
+            # promoted standby starts serving it without a new app).
+            r.add_route("GET", "/admin/ha/sync", self.admin_ha_sync)
         # KV migration wire (only when the engine IS an engine, not a
         # router): the fleet's HttpMember speaks these to ship a live
         # stream's pages + request state between member services.
@@ -177,6 +189,10 @@ class Server:
                         self.admin_migrate_commit)
             r.add_route("POST", "/admin/migrate/abort",
                         self.admin_migrate_abort)
+            # Router HA: a (newly promoted) router claims this member
+            # under its epoch; older epochs are fenced from here on.
+            r.add_route("POST", "/admin/ha/register",
+                        self.admin_ha_register)
         if self.allow_all_routes:
             r.add_route("*", "/{tail:.*}", self.fallback)
         return app
@@ -192,6 +208,42 @@ class Server:
         if ip and core.is_ip_blocked(ip):
             raise ApiError(403, f"ip '{ip}' is blocked")
         return user, ip
+
+    def _fence(self, got: int, kind: str, path: str):
+        """Reject a stale-epoch router call: journal it, count it, 409.
+        The zombie gets told exactly why so its logs explain the fence."""
+        from ollamamq_tpu.telemetry import schema as tm
+
+        cur = self._ha_epoch
+        journal = getattr(self.engine, "journal", None)
+        if journal is not None:
+            try:
+                journal.record("epoch_fence", epoch=cur, stale_epoch=got,
+                               path=path, caller=kind)
+            except Exception:  # noqa: BLE001
+                log.exception("epoch_fence journal failed")
+        tm.HA_FENCED_CALLS_TOTAL.labels(kind=kind).inc()
+        log.warning("fenced stale-epoch router call: epoch %d < %d (%s)",
+                    got, cur, path)
+        raise ApiError(
+            409, f"stale router epoch {got} (current {cur}): this member "
+                 "was taken over by a newer router")
+
+    def _check_epoch(self, request: web.Request, kind: str) -> None:
+        """Epoch fence on member-facing placement/migration calls. No
+        X-Router-Epoch header (HA off, old routers) always passes; a
+        higher epoch is adopted; a lower one is fenced."""
+        hdr = request.headers.get("X-Router-Epoch")
+        if hdr is None:
+            return
+        try:
+            got = int(hdr)
+        except ValueError:
+            raise ApiError(400, "X-Router-Epoch must be an integer")
+        if got >= self._ha_epoch:
+            self._ha_epoch = got
+            return
+        self._fence(got, kind, request.path)
 
     async def _body_json(self, request: web.Request) -> dict:
         if request.method in ("GET", "HEAD"):
@@ -385,6 +437,19 @@ class Server:
             payload["wal"] = wal
             if wal.get("recovering"):
                 payload["status"] = "recovering"
+        # Router HA role block (both roles). A standby answers status
+        # "standby" — NOT "degraded" — so the stock healthcheck (and an
+        # operator's eyeball) reads an idle standby as healthy; during
+        # promotion the status says so, and the promoting router's
+        # Retry-After tells shed clients when to come back.
+        hs_fn = getattr(self.engine, "ha_status", None)
+        hs = hs_fn() if hs_fn is not None else None
+        if hs is not None:
+            payload["role"] = hs.get("role")
+            payload["epoch"] = hs.get("epoch")
+            payload["sync_lag_records"] = hs.get("sync_lag_records")
+            if hs.get("role") in ("standby", "promoting"):
+                payload["status"] = hs["role"]
         return web.json_response(payload)
 
     async def root(self, request: web.Request) -> web.Response:
@@ -842,6 +907,43 @@ class Server:
             raise ApiError(409, str(e))
         return web.json_response({"status": "success", **out})
 
+    # ---------------------------------------------------- router HA wire
+    async def admin_ha_sync(self, request: web.Request) -> web.Response:
+        """The warm standby's replication poll: `?seq=N` acks everything
+        through N and fetches what follows (records, or a whole-file WAL
+        snapshot on cold start / ring overrun) plus the shadow-state
+        blob. 409 unless this router is an HA primary right now — a
+        standby polled by mistake must not serve an empty stream as
+        truth."""
+        self._ident(request)
+        ha = getattr(self.engine, "ha", None)
+        if ha is None or not hasattr(ha, "sync_batch"):
+            raise ApiError(409, "not an HA primary (no replication "
+                                "stream here)")
+        try:
+            seq = int(request.query.get("seq", "0"))
+        except ValueError:
+            raise ApiError(400, "'seq' must be an integer")
+        # Off the event loop: a cold catch-up reads the whole WAL file.
+        resp = await asyncio.get_running_loop().run_in_executor(
+            None, ha.sync_batch, seq)
+        return web.json_response(resp)
+
+    async def admin_ha_register(self, request: web.Request) -> web.Response:
+        """A router (usually a freshly promoted standby) claims this
+        member under its epoch. Equal-or-higher adopts; lower is the
+        zombie ex-primary and fences (409 + journal + metric)."""
+        self._ident(request)
+        body = await self._body_json(request)
+        try:
+            epoch = int(body["epoch"])
+        except (KeyError, TypeError, ValueError):
+            raise ApiError(400, "'epoch' must be an integer")
+        if epoch < self._ha_epoch:
+            self._fence(epoch, "register", request.path)
+        self._ha_epoch = epoch
+        return web.json_response({"ok": True, "epoch": epoch})
+
     # ------------------------------------------------- KV migration wire
     def _migrate_rid(self, body: dict) -> int:
         try:
@@ -857,6 +959,7 @@ class Server:
         target acked) or /admin/migrate/abort (fall back to recompute)
         resolves it. 409 when the request holds no migratable state."""
         self._ident(request)
+        self._check_epoch(request, "migrate")
         body = await self._body_json(request)
         rid = self._migrate_rid(body)
         try:
@@ -881,6 +984,7 @@ class Server:
         it is only sent after the slot is installed; a 409 means nothing
         landed and the caller must fall back to recompute."""
         user, ip = self._ident(request)
+        self._check_epoch(request, "migrate")
         from ollamamq_tpu.engine.engine import MigrationError
         from ollamamq_tpu.engine.kv_cache import unpack_migration_blob
 
@@ -918,6 +1022,7 @@ class Server:
         free identically; abort journals why and signals the recompute
         fallback). 404 when no export is parked under that id."""
         self._ident(request)
+        self._check_epoch(request, "migrate")
         body = await self._body_json(request)
         rid = self._migrate_rid(body)
         why = str(body.get("why") or "transfer_failed")
@@ -978,6 +1083,8 @@ class Server:
     # ------------------------------------------------------------- /api/*
     async def api_generate(self, request: web.Request) -> web.StreamResponse:
         user, ip = self._ident(request)
+        # A fenced ex-primary must not place work here (member side).
+        self._check_epoch(request, "placement")
         body = await self._body_json(request)
         model = body.get("model", "")
         self._resolve_model(model)
@@ -1210,6 +1317,7 @@ class Server:
     # ------------------------------------------------------------ embeddings
     async def api_embed(self, request: web.Request) -> web.Response:
         user, ip = self._ident(request)
+        self._check_epoch(request, "placement")
         body = await self._body_json(request)
         model = body.get("model", "")
         entry = self._resolve_model(model)

@@ -102,6 +102,15 @@ EVENTS = (
     "preempt_notice",    # a preemptible member was served a termination
     #                      notice; resolved by a scale_down for the same
     #                      member within the notice window
+    # Router HA (fleet/ha.py): warm-standby sync, takeover, fencing.
+    "standby_sync",      # standby (re)synced against the primary: cold
+    #                      catch-up, snapshot reload after ring overrun,
+    #                      or reconnect — NOT one record per batch
+    "router_takeover",   # standby promoted to primary, by phase: begin
+    #                      (primary declared dead / handover received) /
+    #                      done (serving, streams re-admitted) / aborted
+    "epoch_fence",       # a stale-epoch router call was rejected — the
+    #                      zombie-primary split-brain guard firing
 )
 
 # kind -> (required fields, optional fields) beyond the common header
@@ -214,6 +223,17 @@ EVENT_FIELDS: Dict[str, Tuple[tuple, tuple]] = {
                    ("tier", "why", "burn", "queued", "fleet", "inflight")),
     "preempt_notice": (("replica",),
                        ("tier", "notice_s", "why", "inflight")),
+    # HA records carry the replication position (seq = last applied
+    # replication record, lag = primary head minus that) and, for
+    # takeovers, the epochs involved plus the promotion outcome counts
+    # (streams re-admitted, how many migrated vs recompute-replayed) —
+    # the inputs tools/journal's takeover-pairing and epoch-monotonicity
+    # audits check across spills.
+    "standby_sync": (("seq", "lag"), ("records", "epoch", "why")),
+    "router_takeover": (("phase", "why"),
+                        ("epoch", "from_epoch", "streams", "migrated",
+                         "replayed", "takeover_ms", "lag")),
+    "epoch_fence": (("epoch", "stale_epoch"), ("path", "caller")),
 }
 assert set(EVENT_FIELDS) == set(EVENTS)
 
@@ -232,7 +252,8 @@ DECISION_KINDS = ("enqueue", "admit", "sched", "place", "shed", "batch",
                   "tier_place", "tier_overflow", "tier_regroup",
                   "migrate_export", "migrate_import", "migrate_abort",
                   "recover_replay", "scale_up", "scale_down",
-                  "preempt_notice")
+                  "preempt_notice", "standby_sync", "router_takeover",
+                  "epoch_fence")
 
 # High-rate bookkeeping kinds eligible for probabilistic sampling
 # (--journal-sample < 1): each record is self-contained (page events
@@ -304,6 +325,11 @@ class Journal:
         self._fh = None
         self._bytes = 0
         self._last_decision: Optional[dict] = None
+        # Optional replication tap (fleet/ha.py): called with each
+        # validated record AFTER it lands in the ring/spill, outside the
+        # journal lock. Exceptions are contained — replication trouble
+        # must not take recording (or serving) down.
+        self.tap = None
         self._tm = {k: tm.JOURNAL_EVENTS_TOTAL.labels(kind=k)
                     for k in EVENTS}
         if self.path:
@@ -414,6 +440,12 @@ class Journal:
                         pass
                     self._fh = None
         self._tm[kind].inc()
+        tap = self.tap
+        if tap is not None:
+            try:
+                tap(rec)
+            except Exception:  # noqa: BLE001
+                pass
         return rec
 
     # -- reading -----------------------------------------------------------
@@ -687,6 +719,35 @@ def explain(rec: dict) -> str:
             s += f" ({rec['notice_s']:g}s window)"
         if rec.get("inflight") is not None:
             s += f", {rec['inflight']} in-flight stream(s) to migrate off"
+        return s
+    if kind == "standby_sync":
+        s = (f"standby synced to replication seq {rec.get('seq', '?')} "
+             f"(lag {rec.get('lag', '?')} record(s)")
+        if rec.get("why"):
+            s += f", {rec['why']}"
+        if rec.get("epoch") is not None:
+            s += f", primary epoch {rec['epoch']}"
+        return s + ")"
+    if kind == "router_takeover":
+        phase = rec.get("phase", "?")
+        s = f"router takeover {phase} ({rec.get('why', '?')})"
+        if rec.get("from_epoch") is not None or rec.get("epoch") is not None:
+            s += (f": epoch {rec.get('from_epoch', '?')} -> "
+                  f"{rec.get('epoch', '?')}")
+        if phase == "done":
+            if rec.get("streams") is not None:
+                s += (f", {rec['streams']} unfinished stream(s) re-admitted"
+                      f" ({rec.get('migrated', 0)} migrated, "
+                      f"{rec.get('replayed', 0)} replayed)")
+            if rec.get("takeover_ms") is not None:
+                s += f", took {rec['takeover_ms']:.0f}ms"
+        return s
+    if kind == "epoch_fence":
+        s = (f"stale-epoch router call fenced: caller epoch "
+             f"{rec.get('stale_epoch', '?')} < current "
+             f"{rec.get('epoch', '?')}")
+        if rec.get("path"):
+            s += f" ({rec['path']})"
         return s
     return f"{kind} {who}"
 

@@ -194,7 +194,8 @@ SLO_ALERTS_FIRING = REGISTRY.gauge(
 WATCHDOG_STALLS_TOTAL = REGISTRY.counter(
     "ollamamq_watchdog_stalls_total",
     "Stall watchdog firings by kind (engine_step, request_phase, "
-    "worker_host, device, replica, scale)", labels=("kind",))
+    "worker_host, device, replica, scale, standby, takeover)",
+    labels=("kind",))
 
 # -- decision journal (telemetry/journal.py; GET /debug/journal) -----------
 JOURNAL_EVENTS_TOTAL = REGISTRY.counter(
@@ -306,6 +307,37 @@ FLEET_PREEMPTIONS_TOTAL = REGISTRY.counter(
     "/admin/preempt/{replica} or the fault plan's preempt_notice site); "
     "each triggers migrate-off-then-retire within the notice window — "
     "spot reclamation with zero dropped streams")
+
+# -- router HA (fleet/ha.py; --ha / --standby-of) --------------------------
+HA_SYNC_LAG_RECORDS = REGISTRY.gauge(
+    "ollamamq_ha_sync_lag_records",
+    "Replication records the warm standby has not yet applied (primary "
+    "head seq minus last acked seq); primary-side it tracks the "
+    "connected standby's ack, standby-side its own apply position — "
+    "what a takeover would have to recover without")
+HA_SYNC_RECORDS_TOTAL = REGISTRY.counter(
+    "ollamamq_ha_sync_records_total",
+    "Replication records shipped over /admin/ha/sync by kind ('wal' = "
+    "admission-WAL records into the standby's WAL replica, 'journal' = "
+    "decision events into the standby's journal spill)",
+    labels=("kind",))
+HA_TAKEOVERS_TOTAL = REGISTRY.counter(
+    "ollamamq_ha_takeovers_total",
+    "Standby promotions to primary by why ('primary_dead' = heartbeat "
+    "loss past the takeover grace, 'handover' = graceful SIGTERM on the "
+    "primary handed the fleet over)", labels=("why",))
+HA_TAKEOVER_DURATION_MS = REGISTRY.histogram(
+    "ollamamq_ha_takeover_duration_ms",
+    "Promotion wall time (ms): primary declared dead to the standby "
+    "serving with every unfinished WAL stream re-admitted — the EMA of "
+    "this feeds promotion-window Retry-After hints",
+    buckets=(10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000, 30000))
+HA_FENCED_CALLS_TOTAL = REGISTRY.counter(
+    "ollamamq_ha_fenced_calls_total",
+    "Stale-epoch router calls a member rejected after a takeover, by "
+    "kind (placement / migrate / register) — each one is a zombie "
+    "primary's write the epoch fence turned away, journaled epoch_fence",
+    labels=("kind",))
 
 # -- crash durability (durability/; --wal-dir) -----------------------------
 WAL_FSYNC_MS = REGISTRY.histogram(

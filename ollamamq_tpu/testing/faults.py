@@ -53,7 +53,13 @@ Each rule names ONE site and ONE trigger:
            a preemptible member a termination notice with the default
            drain-timeout window, "slow" serves one with delay_s as the
            notice window; fires on non-preemptible members are
-           ignored).
+           ignored), or the warm standby's HA heartbeat seam ("router",
+           drawn once per sync poll of the primary: "exception" makes
+           the poll fail as if the primary crashed, "slow" stalls the
+           observed heartbeat by delay_s — past the takeover grace the
+           standby promotes — and "device_loss" keeps polls failing
+           until heal_after_s, so a HEALED primary revives into a
+           promoted fleet: the revive-and-fence chaos case).
   kind     "exception"  -> the dispatch raises FaultInjected (the
                            engine's retry/containment path handles it);
            "slow"       -> the dispatch sleeps delay_s first (stall
@@ -90,7 +96,7 @@ from typing import Dict, List, Optional
 
 SITES = ("prefill", "chunk", "sp_prefill", "ragged", "spec_verify",
          "decode", "embed", "encode", "step", "alloc", "extend", "replica",
-         "migrate", "wal", "preempt")
+         "migrate", "wal", "preempt", "router")
 KINDS = ("exception", "slow", "alloc_fail", "device_loss")
 
 _RULE_KEYS = {"site", "kind", "at", "every", "p", "times", "delay_s",

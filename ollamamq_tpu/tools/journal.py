@@ -253,6 +253,85 @@ def check_scale_pairing(records: List[dict]) -> List[str]:
     return bad
 
 
+def check_takeover_pairing(records: List[dict]) -> List[str]:
+    """Router-HA takeover audit (end-of-run semantics, like the regroup
+    pairing): every `router_takeover` phase="begin" must resolve to a
+    "done" or an "aborted" by journal end — a begin left hanging is a
+    promotion that crashed mid-ladder, which means the fleet may have
+    members re-registered to an epoch no live router serves. Takeovers
+    are serial per process (a standby promotes at most once, a chained
+    standby journals into its own spill), so a begin while another is
+    open is a bug outright. Resolutions with no begin are tolerated
+    (ring tails); the pairing binds on full spills."""
+    open_seq = None
+    bad: List[str] = []
+    for r in records:
+        if r.get("kind") != "router_takeover":
+            continue
+        phase = r.get("phase")
+        if phase == "begin":
+            if open_seq is not None:
+                bad.append(
+                    f"router takeover began at seq {r.get('seq', '?')} "
+                    f"while the begin at seq {open_seq} was never "
+                    "resolved (one promotion at a time)")
+            open_seq = r.get("seq", "?")
+        elif phase in ("done", "aborted"):
+            open_seq = None
+    if open_seq is not None:
+        bad.append(
+            f"router takeover UNRESOLVED: begin at seq {open_seq} never "
+            "reached done/aborted by journal end (promotion crashed "
+            "mid-ladder; members may be fenced to an unserved epoch)")
+    return bad
+
+
+def check_epoch_monotonicity(records: List[dict]) -> List[str]:
+    """Fencing-epoch audit: the epoch is the fleet's split-brain guard,
+    so a takeover "done" must carry an epoch strictly above the epoch
+    it took over from, successive takeovers in one spill must strictly
+    increase, and a member may only fence callers STRICTLY older than
+    the epoch it holds (`stale_epoch < epoch` on every `epoch_fence`)
+    — a fence at equal epochs would reject the live router itself.
+    Runs per spill; `check_files` adds the cross-spill duplicate check
+    (the same epoch completed by two different routers)."""
+    bad: List[str] = []
+    last_done = None
+    for r in records:
+        kind = r.get("kind")
+        seq = r.get("seq", "?")
+        if kind == "router_takeover" and r.get("phase") == "done":
+            epoch = r.get("epoch")
+            if epoch is None:
+                bad.append(
+                    f"router_takeover done at seq {seq} carries no "
+                    "epoch (fencing unverifiable)")
+                continue
+            frm = r.get("from_epoch")
+            if frm is not None and epoch <= frm:
+                bad.append(
+                    f"router_takeover done at seq {seq} did not advance "
+                    f"the epoch ({frm} -> {epoch}): a promoted standby "
+                    "serving an old epoch cannot fence the zombie "
+                    "primary")
+            if last_done is not None and epoch <= last_done:
+                bad.append(
+                    f"router_takeover done at seq {seq} epoch {epoch} "
+                    f"not above the previous takeover's epoch "
+                    f"{last_done} (epochs must be strictly monotonic)")
+            last_done = epoch if last_done is None else max(last_done,
+                                                            epoch)
+        elif kind == "epoch_fence":
+            epoch = r.get("epoch")
+            stale = r.get("stale_epoch")
+            if epoch is not None and stale is not None and stale >= epoch:
+                bad.append(
+                    f"epoch_fence at seq {seq} rejected epoch {stale} "
+                    f"while holding {epoch}: a member may only fence "
+                    "strictly older epochs")
+    return bad
+
+
 def check_stream_attribution(records: List[dict]) -> List[str]:
     """Every stream a recovery touched must reach exactly ONE terminal:
     a failed-over/migrated/WAL-recovered stream with two `finish`
@@ -700,7 +779,7 @@ def check_files(paths: List[str]) -> Tuple[List[str], int]:
     for path in paths:
         meta, records = load_jsonl(path)
         sampled = float(meta.get("sample") or 1.0) < 1.0
-        loaded.append((path, records, sampled))
+        loaded.append((path, records, sampled, meta))
         per_file_recovered.append({
             int(r["wal_rid"]) for r in records
             if r.get("kind") == "recover_replay"
@@ -708,7 +787,7 @@ def check_files(paths: List[str]) -> Tuple[List[str], int]:
             and r.get("outcome") in ("replayed", "finished")})
     bad: List[str] = []
     total = 0
-    for idx, (path, records, sampled) in enumerate(loaded):
+    for idx, (path, records, sampled, _meta) in enumerate(loaded):
         tag = f"{path}: " if len(paths) > 1 else ""
         total += len(records)
         # Cross-crash resolution set: wal_rids recovered by OTHER spills
@@ -729,6 +808,11 @@ def check_files(paths: List[str]) -> Tuple[List[str], int]:
         if any(r.get("kind") in ("scale_up", "scale_down",
                                  "preempt_notice") for r in records):
             bad += [tag + v for v in check_scale_pairing(records)]
+        if any(r.get("kind") == "router_takeover" for r in records):
+            bad += [tag + v for v in check_takeover_pairing(records)]
+        if any(r.get("kind") in ("router_takeover", "epoch_fence")
+               for r in records):
+            bad += [tag + v for v in check_epoch_monotonicity(records)]
         if not any(r.get("kind", "").startswith(("replica_", "migrate_",
                                                  "recover_"))
                    for r in records):
@@ -749,6 +833,29 @@ def check_files(paths: List[str]) -> Tuple[List[str], int]:
             for rid, seq in sorted(open_handoff.items())
         ]
         bad += [tag + v for v in check_stream_attribution(records)]
+    # Cross-spill epoch audit: the same epoch completed ("done") by two
+    # different spills is split brain — two routers both believe they
+    # own the fleet at that epoch. Standby replica files (journal_meta
+    # carries replica_of) are byte copies of another spill and would
+    # duplicate every record, so they are excluded here; the per-file
+    # checks above still bind on them.
+    done_epochs: dict = {}  # epoch -> path of the spill that did it
+    for path, records, _sampled, meta in loaded:
+        if meta.get("replica_of"):
+            continue
+        for r in records:
+            if (r.get("kind") == "router_takeover"
+                    and r.get("phase") == "done"
+                    and r.get("epoch") is not None):
+                ep = r["epoch"]
+                prev = done_epochs.get(ep)
+                if prev is not None and prev != path:
+                    bad.append(
+                        f"epoch {ep} taken over TWICE: router_takeover "
+                        f"done in {prev} and {path} (split brain — two "
+                        "routers promoted into the same epoch)")
+                else:
+                    done_epochs.setdefault(ep, path)
     for n in notes:
         print(n)
     return bad, total

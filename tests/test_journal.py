@@ -126,6 +126,13 @@ _MINIMAL = {
     "wal_admit": dict(fsync_ms=1.25, n_prompt=16),
     "recover_replay": dict(tokens=5, outcome="replayed", n_prompt=16,
                            wal_rid=3),
+    "standby_sync": dict(seq=42, lag=0, records=14, epoch=2,
+                         why="snapshot"),
+    "router_takeover": dict(phase="done", why="primary_dead", epoch=3,
+                            from_epoch=2, streams=2, migrated=0,
+                            replayed=2, takeover_ms=812.5, lag=0),
+    "epoch_fence": dict(epoch=3, stale_epoch=2, path="placement",
+                        caller="router"),
 }
 
 
@@ -137,13 +144,13 @@ def test_every_kind_records_and_explains():
         text = explain(rec)
         assert isinstance(text, str) and text
     assert j.seq == len(EVENTS)
-    # The TUI line tracks the newest DECISION kind (the recovery replay
-    # is the last one in the vocabulary walk above); page/broadcast/
+    # The TUI line tracks the newest DECISION kind (the epoch fence is
+    # the last one in the vocabulary walk above); page/broadcast/
     # rebuild bookkeeping must not displace it.
-    assert "recovered from the WAL" in j.last_summary()
+    assert "stale-epoch router call fenced" in j.last_summary()
     j.record("page_alloc", model="m", n=1, free=9, used=21, cached=1,
              pool=31)
-    assert "recovered from the WAL" in j.last_summary()
+    assert "stale-epoch router call fenced" in j.last_summary()
 
 
 def test_tail_filters():

@@ -354,6 +354,34 @@ def test_tui_fleet_size_chip_via_pty(tmp_path):
         t.close()
 
 
+# Engine stub shaped like a warm standby (fleet/ha.py): ha_status()
+# feeds the HA role chip — role + fencing epoch, standby-side with its
+# replication lag in records.
+_CHILD_HA = _CHILD.replace(
+    'eng.runtimes = {}\nadmin_tui.run_tui(eng, None, refresh_ms=50)',
+    '''eng.runtimes = {}
+eng.ha_status = lambda: {"role": "standby", "epoch": 3,
+                         "sync_lag_records": 12, "synced": True}
+admin_tui.run_tui(eng, None, refresh_ms=50)''')
+assert _CHILD_HA != _CHILD, "ha child patch failed to apply"
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="pty/termios test")
+def test_tui_ha_role_chip_via_pty(tmp_path):
+    """Router-HA TUI: the role/epoch chip renders in the frames — a
+    standby shows `ha standby/<epoch>` with its replication lag, so an
+    operator can see at a glance which process owns the fleet."""
+    t = _PtyTui(tmp_path, child_src=_CHILD_HA)
+    try:
+        assert t.wait_output(b"ha standby/3"), _stderr(t)
+        assert t.wait_output(b"lag 12"), _stderr(t)
+        t.send("q")
+        assert t.wait_output(b"TUI_EXIT_OK"), _stderr(t)
+        assert t.proc.wait(timeout=30) == 0
+    finally:
+        t.close()
+
+
 @pytest.mark.skipif(sys.platform != "linux", reason="pty/termios test")
 def test_tui_no_alerts_renders_quiet_panel(tmp_path):
     """Without an alert table (or with it empty) the ALERTS section still
