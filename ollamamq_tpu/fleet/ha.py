@@ -32,9 +32,16 @@ module closes that hole with a PRIMARY/STANDBY pair:
     zombie primary that revives after takeover is fenced out of the
     fleet, not split-braining it;
   - graceful handover: SIGTERM on an HA primary flips the sync stream's
-    handover flag; the caught-up standby confirms (one final ack poll)
-    and promotes with why="handover" — the fleet changes routers
-    without draining the world.
+    handover flag; the standby then CATCHES UP — it keeps polling and
+    applying until its cursor reaches the primary's head (covering
+    records past the last routine poll: quiesce-drain tok/fin and any
+    backlog beyond one batch) and only then sends a confirm poll
+    (`confirm=1`). The primary's SIGTERM wait releases on that confirm
+    alone — never on a routine poll, which at lag 0 would let the
+    primary exit before the standby even started catching up — and the
+    standby promotes with why="handover". The fleet changes routers
+    without draining the world, and nothing durably ACKed is left out
+    of the replica.
 
 Fault site "router" (testing/faults.py) is drawn once per sync poll:
 "exception" fails the poll as if the primary crashed, "slow" stalls the
@@ -162,11 +169,18 @@ class HACoordinator:
 
     # -- the sync endpoint's engine half -----------------------------------
     def sync_batch(self, from_seq: int,
-                   max_records: int = SYNC_MAX_RECORDS) -> dict:
+                   max_records: int = SYNC_MAX_RECORDS, *,
+                   want_snapshot: bool = False,
+                   confirm_handover: bool = False) -> dict:
         """One standby poll: ack `from_seq`, return records past it (or
         a whole-file WAL snapshot on cold start / ring overrun) plus the
         shadow-state blob. The poll cursor is the ack — no second
-        round-trip."""
+        round-trip. `want_snapshot` is the standby's explicit one-time
+        initial-snapshot request (it sends it until a snapshot lands).
+        `confirm_handover` is the standby's caught-up handover confirm:
+        only it releases the SIGTERM wait — a routine poll at lag 0
+        would otherwise release the primary before the standby had even
+        begun catching up, and the primary would exit under it."""
         from_seq = max(0, int(from_seq))
         now = time.monotonic()
         with self._lock:
@@ -176,8 +190,13 @@ class HACoordinator:
             oldest = self._ring[0][0] if self._ring else self.head + 1
             # Cold catch-up ALWAYS snapshots: begin()'s compaction lines
             # bypass the mirror, so seq-0 record replay would miss them.
-            need_snapshot = from_seq <= 0 or from_seq + 1 < oldest
-            if self.handover and from_seq >= self._handover_target:
+            # head == 0 (idle/fresh primary) only snapshots when the
+            # standby asks — otherwise every poll would re-ship and
+            # re-fsync the whole replica until the first record lands.
+            need_snapshot = (want_snapshot or from_seq + 1 < oldest
+                             or (from_seq <= 0 < self.head))
+            if (self.handover and confirm_handover
+                    and from_seq >= self._handover_target):
                 self._handover_acked.set()
         resp = {"role": "primary", "epoch": self.epoch,
                 "handover": self.handover,
@@ -237,10 +256,11 @@ class HACoordinator:
 
     # -- handover (graceful SIGTERM on the primary) ------------------------
     def request_handover(self, timeout_s: float = 10.0) -> bool:
-        """Advertise handover on the sync stream and wait for the standby
-        to ack everything up to the current head (its promotion follows
-        immediately). False = no standby ever connected, or it never
-        confirmed in time — the caller falls back to draining."""
+        """Advertise handover on the sync stream and wait for a
+        caught-up standby confirm poll acking everything up to the
+        current head (its promotion follows immediately). False = no
+        standby ever connected, or it never confirmed in time — the
+        caller falls back to draining."""
         with self._lock:
             if self._last_poll is None:
                 return False
@@ -255,6 +275,12 @@ class HACoordinator:
                 self.handover = False  # stop advertising; we drain instead
             log.error("HA handover timed out after %.1fs — falling back "
                       "to drain", timeout_s)
+        else:
+            # The confirm poll's HTTP response is still being written on
+            # the event loop (the ack fires in the handler, before the
+            # write). Give it a beat so the standby sees the answer
+            # instead of a socket cut by our exit.
+            time.sleep(0.2)
         return ok
 
     def promote_eta_s(self) -> Optional[float]:
@@ -301,6 +327,7 @@ class HAStandby:
         self.takeover_ms_ema = load_ha_state(self.wal_dir) \
             .get("takeover_ms_ema")
         self.last_error: Optional[str] = None
+        self._never_synced_logged: Optional[float] = None
         self.promoted = threading.Event()
         self._promote_begin: Optional[float] = None
         self._last_ok = time.monotonic()
@@ -377,20 +404,97 @@ class HAStandby:
                     self.last_error = str(e)  # the expected failure mode
                     self._had_failure = True
             if handover:
-                # Confirm: one final poll acks everything we applied
-                # (from_seq >= the primary's handover target), releasing
-                # its SIGTERM path; then take over.
-                try:
-                    self._poll()
-                except Exception:  # noqa: BLE001
-                    pass
-                if self.promote(why="handover"):
-                    return
+                # Catch up to the primary's head BEFORE taking over:
+                # records past our last routine poll (quiesce-drain
+                # tok/fin, any backlog beyond one batch) must be in the
+                # replica, or a durably-ACKed admission could vanish at
+                # takeover. A caught-up confirm poll — never a routine
+                # one — releases the primary's SIGTERM wait.
+                if self._handover_catchup():
+                    if self.promote(why="handover"):
+                        return
+                # Catch-up failed (primary died mid-handover, or it
+                # timed out waiting and fell back to draining): stay
+                # standby — a dead primary still promotes below once
+                # the grace expires.
             if time.monotonic() - self._last_ok > self.grace:
-                if self.promote(why="primary_dead"):
+                if not self.synced:
+                    # NEVER promote off an empty replica: a standby that
+                    # has not synced once (booted before the primary,
+                    # wrong --standby-of URL, partitioned) would fence a
+                    # possibly-healthy primary out of its own fleet and
+                    # serve nothing — an outage caused by HA itself.
+                    self._alert_never_synced()
+                elif self.promote(why="primary_dead"):
                     return
             if self._stop.wait(self.poll_s):
                 return
+
+    def _handover_catchup(self, timeout_s: float = 30.0) -> bool:
+        """Drain the sync stream to the primary's head, then send a
+        confirm poll (confirm=1) — only that releases the primary's
+        SIGTERM wait, so it cannot exit before the replica holds
+        everything it shipped. False = the primary died mid-handover
+        or withdrew the offer (its wait timed out and it is draining
+        instead): the caller must NOT promote off it."""
+        deadline = time.monotonic() + timeout_s
+        failures = 0
+        confirmed = False  # a confirm poll the primary answered
+        while not self._stop.is_set() and time.monotonic() < deadline:
+            confirm = self.applied >= self.head
+            try:
+                resp = self._poll(confirm=confirm)
+                self._apply(resp)
+                self._last_ok = time.monotonic()
+                failures = 0
+            except Exception as e:  # noqa: BLE001
+                self.last_error = str(e)
+                self._had_failure = True
+                if confirmed:
+                    # The primary exits the moment an answered confirm
+                    # lands; a dead socket past that point IS the
+                    # planned exit — the replica already holds
+                    # everything it shipped.
+                    return True
+                failures += 1
+                if failures >= 3:
+                    return False  # primary died before confirming
+                time.sleep(min(0.2, self.poll_s))
+                continue
+            if not resp.get("handover"):
+                return False  # withdrawn: the primary drains itself
+            if confirm:
+                confirmed = True
+                if (not resp.get("records")
+                        and resp.get("snapshot") is None):
+                    # Confirmed at head with nothing new: the primary
+                    # released on this very poll.
+                    return True
+            # Records still flowing (backlog or quiesce-drain tok/fin):
+            # keep draining and re-confirm once caught up again.
+        return False
+
+    def _alert_never_synced(self) -> None:
+        """Grace expired but no snapshot ever landed: alert + log
+        (throttled), keep polling — promotion stays refused."""
+        now = time.monotonic()
+        if (self._never_synced_logged is None
+                or now - self._never_synced_logged > max(5.0, self.grace)):
+            self._never_synced_logged = now
+            log.error(
+                "primary %s unreachable for %.1fs but this standby has "
+                "NEVER synced — refusing to promote an empty replica "
+                "(wrong --standby-of URL, primary not up yet, or a "
+                "partition); will keep polling",
+                self.primary_url, now - self._last_ok)
+        alerts = getattr(self.router, "alerts", None)
+        if alerts is not None:
+            alerts.fire(
+                "standby_never_synced", "page",
+                "takeover grace expired before the first successful "
+                "sync: promotion refused (an unsynced standby would "
+                "fence the primary and serve an empty fleet) — check "
+                f"--standby-of {self.primary_url}", source="ha")
 
     def _fault_round(self) -> bool:
         """Draw the "router" fault site for this poll round. True = the
@@ -408,8 +512,17 @@ class HAStandby:
             self.last_error = "injected router fault"
         return failed
 
-    def _poll(self) -> dict:
+    def _poll(self, confirm: bool = False) -> dict:
+        # snap=1 until the first snapshot lands: the initial catch-up
+        # must be whole-file (compaction lines bypass the record
+        # mirror), and asking explicitly lets an idle primary (head 0)
+        # serve it once instead of re-shipping on every cold poll.
+        # confirm=1 is the caught-up handover ack (_handover_catchup).
         url = f"{self.primary_url}/admin/ha/sync?seq={self.applied}"
+        if not self.synced:
+            url += "&snap=1"
+        if confirm:
+            url += "&confirm=1"
         req = urllib.request.Request(
             url, headers={"Accept": "application/json"})
         timeout = max(0.2, min(2.0, self.grace))
@@ -447,6 +560,9 @@ class HAStandby:
         self.applied = int(resp.get("snapshot_head") or 0)
         self.head = max(self.head, self.applied)
         self.synced = True
+        alerts = getattr(self.router, "alerts", None)
+        if alerts is not None:
+            alerts.resolve("standby_never_synced")
         for _ in lines:
             tm.HA_SYNC_RECORDS_TOTAL.labels(kind="wal").inc()
         self.router.journal.record(
@@ -520,10 +636,28 @@ class HAStandby:
             # tree still holds its prefix — the warm-pool fast path).
             r.start()
         except Exception:  # noqa: BLE001
-            log.exception("promotion ABORTED: router start failed; "
-                          "returning to standby")
+            # The fence side effects are already out: members were
+            # re-registered under new_epoch, which no router serves
+            # until a promotion lands. Journal that fact, and adopt
+            # new_epoch as seen so the RETRY claims a strictly higher
+            # one (epoch monotonicity holds even across aborts).
+            log.exception(
+                "promotion ABORTED: router start failed; returning to "
+                "standby. %d member(s) remain claimed at epoch %d (no "
+                "router serves it — the old primary is fenced until a "
+                "promotion lands or it re-registers above it)",
+                len(r.members), new_epoch)
             r.journal.record("router_takeover", phase="aborted", why=why,
-                             epoch=new_epoch, from_epoch=from_epoch)
+                             epoch=new_epoch, from_epoch=from_epoch,
+                             members_claimed=len(r.members))
+            alerts = getattr(r, "alerts", None)
+            if alerts is not None:
+                alerts.fire(
+                    "takeover_aborted", "page",
+                    f"promotion to epoch {new_epoch} aborted after "
+                    f"members were claimed at it: no router serves that "
+                    "epoch until a retry lands", source="ha")
+            self.epoch_seen = new_epoch
             r.accepting = False
             self.role = "standby"
             self._last_ok = time.monotonic()
@@ -541,6 +675,9 @@ class HAStandby:
         r.ha.on_router_start()
         self.role = "primary"
         self.takeover_count += 1
+        alerts = getattr(r, "alerts", None)
+        if alerts is not None:
+            alerts.resolve("takeover_aborted")
         self.promoted.set()
         tm.HA_TAKEOVERS_TOTAL.labels(why=why).inc()
         tm.HA_TAKEOVER_DURATION_MS.observe(ms)

@@ -533,6 +533,7 @@ class HttpMember(_MemberBase):
                 self._last_ok = time.monotonic()
             except Exception:  # noqa: BLE001 — staleness IS the signal
                 continue
+            self._repair_epoch()
             # Federation scrape rides the SAME heartbeat: a member whose
             # /health answers but whose snapshot endpoint fails (old
             # member build, transient error) keeps its LAST snapshot —
@@ -546,6 +547,21 @@ class HttpMember(_MemberBase):
                 pass
 
     # -- router HA ---------------------------------------------------------
+    def _repair_epoch(self) -> None:
+        """Heartbeat fence repair: a member that RESTARTED after a
+        takeover reports an epoch below ours on /health (a fresh
+        process holds 0 unless it persisted the fence) — until it
+        re-adopts, a zombie ex-primary's retried calls would pass its
+        fence again. Re-register it under our epoch within one poll."""
+        if self.router_epoch is None or self.fenced:
+            return
+        try:
+            seen = int(self._status.get("epoch") or 0)
+        except (TypeError, ValueError):
+            return
+        if seen < self.router_epoch:
+            self.register(self.router_epoch)
+
     def _epoch_headers(self, headers: dict) -> dict:
         if self.router_epoch is not None:
             headers["X-Router-Epoch"] = str(self.router_epoch)

@@ -310,23 +310,34 @@ class FleetRouter:
         if self._running:
             return
         self._running = True
-        for mem in self.members:
-            mem.start()
-        if self.ha is not None and hasattr(self.ha, "on_router_start"):
-            # Stamp every member with our epoch before placements land.
-            self.ha.on_router_start()
-        self._thread = threading.Thread(target=self._loop, name="fleet",
-                                        daemon=True)
-        self._thread.start()
-        if self.health is None:
-            from ollamamq_tpu.engine.health import HealthMonitor
+        try:
+            for mem in self.members:
+                mem.start()  # member starts are idempotent
+            if self.ha is not None and hasattr(self.ha, "on_router_start"):
+                # Stamp every member with our epoch before placements
+                # land.
+                self.ha.on_router_start()
+            self._thread = threading.Thread(target=self._loop,
+                                            name="fleet", daemon=True)
+            self._thread.start()
+            if self.health is None:
+                from ollamamq_tpu.engine.health import HealthMonitor
 
-            self.health = HealthMonitor(self)
-            self.health.start()
-        if self.durability is not None:
-            # Fleet-wide recovery: WAL'd streams re-enter the router
-            # queue and re-place across whichever members survived.
-            self.durability.start(self)
+                self.health = HealthMonitor(self)
+                self.health.start()
+            if self.durability is not None:
+                # Fleet-wide recovery: WAL'd streams re-enter the
+                # router queue and re-place across whichever members
+                # survived.
+                self.durability.start(self)
+        except Exception:
+            # A partial start must stay retryable (HA promotion retries
+            # start() after an abort): clear the running flag so the
+            # retry re-runs the ladder instead of no-opping, and wake
+            # the fleet thread (if it got up) so it exits.
+            self._running = False
+            self.notify()
+            raise
 
     def stop(self) -> None:
         self._running = False

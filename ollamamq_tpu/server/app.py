@@ -18,8 +18,10 @@ but handlers drive the in-tree TPU engine instead of proxying HTTP:
 from __future__ import annotations
 
 import asyncio
+import functools
 import json
 import logging
+import os
 import time
 import uuid
 
@@ -102,8 +104,22 @@ class Server:
         # HIGHER epoch adopts it (the new primary owns us even if its
         # explicit /admin/ha/register never arrived); a LOWER one is a
         # zombie ex-primary and gets fenced with 409. 0 = HA never seen,
-        # header-less callers always pass.
+        # header-less callers always pass. Persisted next to the WAL
+        # when one exists so a member RESTART cannot regress the fence
+        # and let the zombie back in; WAL-less members instead re-adopt
+        # via the router heartbeat (it re-registers any member whose
+        # /health reports a lower epoch within one poll).
         self._ha_epoch = 0
+        self._epoch_path = None
+        wal_dir = getattr(getattr(engine, "ecfg", None), "wal_dir", None)
+        if wal_dir:
+            self._epoch_path = os.path.join(wal_dir, "member_epoch.json")
+            try:
+                with open(self._epoch_path, encoding="utf-8") as f:
+                    self._ha_epoch = max(0, int(json.load(f)["epoch"]))
+            except (OSError, KeyError, TypeError, ValueError,
+                    json.JSONDecodeError):
+                pass
 
     # ------------------------------------------------------------------ app
     def build_app(self) -> web.Application:
@@ -241,9 +257,30 @@ class Server:
         except ValueError:
             raise ApiError(400, "X-Router-Epoch must be an integer")
         if got >= self._ha_epoch:
-            self._ha_epoch = got
+            self._adopt_epoch(got)
             return
         self._fence(got, kind, request.path)
+
+    def _adopt_epoch(self, epoch: int) -> None:
+        """Adopt a (new) router epoch, durably when a WAL dir exists:
+        write-new-then-rename + fsync, so a member restart revives at
+        the fence it held — not at 0, where a zombie ex-primary's
+        retried calls would pass again."""
+        if epoch == self._ha_epoch:
+            return
+        self._ha_epoch = epoch
+        if self._epoch_path is None:
+            return
+        tmp = self._epoch_path + ".new"
+        try:
+            os.makedirs(os.path.dirname(self._epoch_path), exist_ok=True)
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"epoch": int(epoch)}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._epoch_path)
+        except OSError:
+            log.exception("member epoch persist failed (epoch %d)", epoch)
 
     async def _body_json(self, request: web.Request) -> dict:
         if request.method in ("GET", "HEAD"):
@@ -450,6 +487,12 @@ class Server:
             payload["sync_lag_records"] = hs.get("sync_lag_records")
             if hs.get("role") in ("standby", "promoting"):
                 payload["status"] = hs["role"]
+        elif self._ha_epoch:
+            # Member side: the adopted fencing epoch, so the router's
+            # heartbeat can spot a restarted member that regressed below
+            # the fleet epoch and re-register it (closing the zombie
+            # window for WAL-less members).
+            payload["epoch"] = self._ha_epoch
         return web.json_response(payload)
 
     async def root(self, request: web.Request) -> web.Response:
@@ -924,9 +967,16 @@ class Server:
             seq = int(request.query.get("seq", "0"))
         except ValueError:
             raise ApiError(400, "'seq' must be an integer")
+        # snap=1: the standby's one-time initial-snapshot request (sent
+        # until its first snapshot lands). confirm=1: the caught-up
+        # handover ack — the only poll that releases a SIGTERM wait.
+        want_snapshot = request.query.get("snap") == "1"
+        confirm = request.query.get("confirm") == "1"
         # Off the event loop: a cold catch-up reads the whole WAL file.
         resp = await asyncio.get_running_loop().run_in_executor(
-            None, ha.sync_batch, seq)
+            None, functools.partial(ha.sync_batch, seq,
+                                    want_snapshot=want_snapshot,
+                                    confirm_handover=confirm))
         return web.json_response(resp)
 
     async def admin_ha_register(self, request: web.Request) -> web.Response:
@@ -941,7 +991,7 @@ class Server:
             raise ApiError(400, "'epoch' must be an integer")
         if epoch < self._ha_epoch:
             self._fence(epoch, "register", request.path)
-        self._ha_epoch = epoch
+        self._adopt_epoch(epoch)
         return web.json_response({"ok": True, "epoch": epoch})
 
     # ------------------------------------------------- KV migration wire

@@ -232,7 +232,8 @@ EVENT_FIELDS: Dict[str, Tuple[tuple, tuple]] = {
     "standby_sync": (("seq", "lag"), ("records", "epoch", "why")),
     "router_takeover": (("phase", "why"),
                         ("epoch", "from_epoch", "streams", "migrated",
-                         "replayed", "takeover_ms", "lag")),
+                         "replayed", "takeover_ms", "lag",
+                         "members_claimed")),
     "epoch_fence": (("epoch", "stale_epoch"), ("path", "caller")),
 }
 assert set(EVENT_FIELDS) == set(EVENTS)

@@ -30,6 +30,8 @@ from ollamamq_tpu.engine.fake import FakeEngine
 from ollamamq_tpu.engine.health import HealthMonitor
 from ollamamq_tpu.fleet import FleetRouter, LocalMember
 from ollamamq_tpu.fleet.ha import HAStandby, load_ha_state
+from ollamamq_tpu.fleet.members import HttpMember
+from ollamamq_tpu.server.app import Server
 from ollamamq_tpu.ops.sampling import SamplingParams
 from ollamamq_tpu.telemetry.slo import AlertManager
 from ollamamq_tpu.testing.faults import FaultPlan
@@ -319,6 +321,305 @@ def test_standby_router_fault_site(tmp_path):
         assert eta is not None and eta >= 1.0
     finally:
         router.stop()
+
+
+def _standby_router(tmp_path, grace=3.0):
+    """Unstarted standby-side router + HAStandby pair (no sockets)."""
+    ecfg = EngineConfig(wal_dir=str(tmp_path / "wal-s"), wal_fsync_ms=2.0,
+                        takeover_grace_s=grace, **TINY)
+    member_cfg = dataclasses.replace(ecfg, wal_dir=None)
+    router = FleetRouter(
+        [LocalMember("r0", FakeEngine(member_cfg, blocklist_path=None,
+                                      token_latency_s=0.0))],
+        ecfg, blocklist_path=None, **FAST)
+    return router, HAStandby(router, "http://127.0.0.1:1")
+
+
+def _alert_names(router):
+    return [a.name for a in router.alerts.active()]
+
+
+def test_sync_initial_snapshot_is_explicit_not_a_storm(tmp_path):
+    """An idle primary (head 0 — e.g. freshly promoted, no traffic yet)
+    must NOT re-ship + re-fsync the whole WAL replica on every cold
+    poll: the standby asks for its one-time initial snapshot with
+    snap=1, and plain from-seq-0 polls tail (empty) records."""
+    router = _ha_router(tmp_path)
+    try:
+        ha = router.ha
+        # Simulate the freshly-promoted idle case: nothing mirrored.
+        with ha._lock:
+            ha._ring.clear()
+            ha.head = 0
+        r1 = ha.sync_batch(0)
+        assert "snapshot" not in r1 and r1["records"] == []
+        # The explicit one-time request gets the whole file.
+        r2 = ha.sync_batch(0, want_snapshot=True)
+        assert r2.get("snapshot") is not None
+        # Synced: back to (empty) record tailing, no re-snapshot.
+        r3 = ha.sync_batch(r2["snapshot_head"])
+        assert "snapshot" not in r3 and r3["records"] == []
+        # With records past seq 0, a cold poll still snapshots (WAL
+        # compaction lines bypass the mirror).
+        req = router.enqueue_request(
+            "u", "1.2.3.4", "test-tiny", prompt_tokens=[1, 2],
+            sampling=SamplingParams(max_tokens=2))
+        collect(req)
+        r4 = ha.sync_batch(0)
+        assert r4.get("snapshot") is not None
+    finally:
+        router.stop()
+
+
+def test_handover_released_only_by_confirm_poll(tmp_path):
+    """A routine poll at lag 0 must NOT release the primary's SIGTERM
+    wait: at the instant SIGTERM lands, the standby's next routine poll
+    already carries from_seq == head, and releasing on it would let the
+    primary exit before the standby even learned of the handover. Only
+    the explicit caught-up confirm poll releases."""
+    router = _ha_router(tmp_path)
+    try:
+        ha = router.ha
+        with ha._lock:
+            ha.handover = True
+            ha._handover_target = ha.head
+            ha._handover_acked.clear()
+        # Routine caught-up poll: advertises the handover, releases
+        # nothing.
+        resp = ha.sync_batch(ha.head)
+        assert resp["handover"] is True
+        assert not ha._handover_acked.is_set()
+        # A confirm poll BELOW the target releases nothing either.
+        if ha.head > 0:
+            ha.sync_batch(ha.head - 1, confirm_handover=True)
+            assert not ha._handover_acked.is_set()
+        # The caught-up confirm poll is the release.
+        ha.sync_batch(ha.head, confirm_handover=True)
+        assert ha._handover_acked.is_set()
+    finally:
+        router.stop()
+
+
+def test_handover_catchup_drains_backlog_before_promote(tmp_path):
+    """The zero-drop handover contract: the standby applies EVERYTHING
+    up to the primary's head — multi-batch backlog included — and only
+    a caught-up poll carries confirm=1 (the ack that releases the
+    primary's SIGTERM wait). A confirm poll's records are never
+    discarded."""
+    router, sb = _standby_router(tmp_path)
+    try:
+        sb._open_replicas()
+        sb.synced = True
+
+        def wal(seq):
+            return {"seq": seq, "kind": "wal",
+                    "rec": {"k": "admit", "rid": seq, "user": "u",
+                            "model": "test-tiny", "kind": "generate",
+                            "prompt": [1], "sampling": {}}}
+
+        responses = [
+            {"handover": True, "epoch": 1, "head": 4,
+             "records": [wal(1), wal(2)], "state": {}},
+            {"handover": True, "epoch": 1, "head": 4,
+             "records": [wal(3), wal(4)], "state": {}},
+        ]
+        polls = []
+
+        def poll(confirm=False):
+            polls.append((sb.applied, confirm))
+            if responses:
+                return responses.pop(0)
+            return {"handover": True, "epoch": 1, "head": 4,
+                    "records": [], "state": {}}
+
+        sb._poll = poll
+        assert sb._handover_catchup() is True
+        assert sb.applied == 4 and sb.head == 4
+        # The releasing ack carried the full head AND the confirm flag;
+        # the mid-backlog poll (applied 2 < head 4) confirmed nothing.
+        assert polls[-1] == (4, True)
+        assert (2, False) in polls
+        # Both batches landed in the replica WAL (nothing discarded).
+        prev, torn = load_wal_records(sb._wal_path)
+        assert torn == 0 and sorted(prev) == [1, 2, 3, 4]
+    finally:
+        sb._close_replicas()
+        router.stop()
+
+
+def test_handover_withdrawn_or_dead_primary_aborts_catchup(tmp_path):
+    """Catch-up must NOT confirm a handover the primary withdrew (its
+    wait timed out; it is draining itself — promoting would fence a
+    live, draining router), nor spin forever against a dead one."""
+    router, sb = _standby_router(tmp_path)
+    try:
+        sb._open_replicas()
+        sb.synced = True
+        sb._poll = lambda confirm=False: {
+            "handover": False, "epoch": 1, "head": 0,
+            "records": [], "state": {}}
+        assert sb._handover_catchup() is False
+
+        def boom(confirm=False):
+            raise OSError("connection refused")
+
+        sb._poll = boom
+        assert sb._handover_catchup() is False
+        assert sb.role == "standby" and not sb.promoted.is_set()
+    finally:
+        sb._close_replicas()
+        router.stop()
+
+
+def test_never_synced_standby_refuses_promotion(tmp_path):
+    """A standby that has NEVER completed a first sync (booted before
+    the primary, wrong URL, partitioned) must not promote after the
+    grace: it would fence a possibly-healthy primary out of its own
+    fleet and serve an empty replica. It alerts and keeps polling."""
+    router, sb = _standby_router(tmp_path, grace=0.3)
+    try:
+        sb.start()  # primary URL is unreachable: every poll fails
+        time.sleep(1.2)  # several grace windows elapse
+        assert sb.role == "standby" and not sb.promoted.is_set()
+        assert not sb.synced
+        assert "standby_never_synced" in _alert_names(router)
+        assert not [r for r in router.journal.tail(None)
+                    if r.get("kind") == "router_takeover"]
+        sb.stop()
+        # The first snapshot resolves the alert (and arms promotion).
+        sb._apply_snapshot({"snapshot": [], "snapshot_head": 0})
+        assert sb.synced
+        assert "standby_never_synced" not in _alert_names(router)
+    finally:
+        sb.stop()
+        router.stop()
+
+
+def test_aborted_promotion_bumps_epoch_and_retries_clean(tmp_path):
+    """An aborted promotion already re-registered the members at the
+    new epoch: the abort journals that fact (+ alert), and the RETRY
+    claims a strictly higher epoch over an idempotently-restartable
+    router — monotonicity holds across the abort."""
+    router, sb = _standby_router(tmp_path)
+    try:
+        sb._open_replicas()
+        sb.synced = True
+        real_start = router.start
+        calls = {"n": 0}
+
+        def flaky_start():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("recovery wedged")
+            real_start()
+
+        router.start = flaky_start
+        assert sb.promote(why="primary_dead") is False
+        assert sb.role == "standby" and not sb.promoted.is_set()
+        assert sb.epoch_seen == 2  # claimed-but-unserved epoch adopted
+        assert not router.accepting
+        assert "takeover_aborted" in _alert_names(router)
+        aborted = [r for r in router.journal.tail(None)
+                   if r.get("kind") == "router_takeover"
+                   and r.get("phase") == "aborted"]
+        assert aborted and aborted[-1]["members_claimed"] == 1
+        assert aborted[-1]["epoch"] == 2
+
+        assert sb.promote(why="primary_dead") is True
+        assert sb.role == "primary" and router.epoch == 3
+        assert "takeover_aborted" not in _alert_names(router)
+        recs = [r for r in router.journal.tail(None)
+                if r.get("kind") == "router_takeover"]
+        assert check_takeover_pairing(recs) == []
+        assert check_epoch_monotonicity(recs) == []
+        assert [r for r in recs if r.get("phase") == "done"][-1][
+            "epoch"] == 3
+    finally:
+        router.stop()
+
+
+def test_router_start_partial_failure_is_retryable(tmp_path):
+    """A start() that raises partway (e.g. recovery wedged) must leave
+    the router restartable — the HA promotion retry path depends on
+    it — without double-starting members."""
+    ecfg = EngineConfig(wal_dir=str(tmp_path / "wal"), wal_fsync_ms=2.0,
+                        **TINY)
+    member_cfg = dataclasses.replace(ecfg, wal_dir=None)
+    router = FleetRouter(
+        [LocalMember("r0", FakeEngine(member_cfg, blocklist_path=None,
+                                      token_latency_s=0.0))],
+        ecfg, blocklist_path=None, **FAST)
+    real_dur_start = router.durability.start
+    calls = {"n": 0}
+
+    def flaky(engine):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("boom")
+        real_dur_start(engine)
+
+    router.durability.start = flaky
+    try:
+        with pytest.raises(RuntimeError):
+            router.start()
+        assert router._running is False
+        router.start()  # retry actually re-runs the ladder
+        assert router._running is True and calls["n"] == 2
+        req = router.enqueue_request(
+            "u", "1.2.3.4", "test-tiny", prompt_tokens=[1],
+            sampling=SamplingParams(max_tokens=2))
+        assert collect(req)[-1].kind == "done"
+    finally:
+        router.stop()
+
+
+def test_member_epoch_persists_across_restart(tmp_path):
+    """The member-side fence must survive a member restart: with a WAL
+    dir the adopted epoch persists (member_epoch.json), so a fresh
+    process revives AT the fence instead of at 0 — where the zombie
+    ex-primary's retried calls would pass again."""
+    ecfg = EngineConfig(wal_dir=str(tmp_path / "mw"), **TINY)
+    eng = FakeEngine(ecfg, blocklist_path=None, token_latency_s=0.0)
+    srv = Server(eng)
+    assert srv._ha_epoch == 0
+    srv._adopt_epoch(3)
+    assert json.load(open(os.path.join(
+        str(tmp_path / "mw"), "member_epoch.json")))["epoch"] == 3
+    # "Restart": a fresh Server over the same state dir holds the fence.
+    srv2 = Server(eng)
+    assert srv2._ha_epoch == 3
+    # WAL-less member: memory-only, as before (heartbeat repair covers
+    # it — see test_http_member_heartbeat_repairs_regressed_epoch).
+    eng2 = FakeEngine(dataclasses.replace(ecfg, wal_dir=None),
+                      blocklist_path=None, token_latency_s=0.0)
+    srv3 = Server(eng2)
+    srv3._adopt_epoch(5)
+    assert Server(eng2)._ha_epoch == 0
+
+
+def test_http_member_heartbeat_repairs_regressed_epoch():
+    """The router heartbeat re-registers a member whose /health reports
+    an epoch below the fleet's (a restarted WAL-less member) — closing
+    the window where the zombie's calls would pass its reset fence."""
+    m = HttpMember("m0", "http://127.0.0.1:1")
+    calls = []
+    m.register = lambda e: calls.append(e) or True
+    m._status = {"status": "ok"}  # no epoch reported
+    m._repair_epoch()
+    assert calls == []            # HA off: nothing to repair
+    m.router_epoch = 2
+    m._repair_epoch()
+    assert calls == [2]           # regressed (0 < 2): re-register
+    m._status = {"status": "ok", "epoch": 2}
+    m._repair_epoch()
+    assert calls == [2]           # caught up: no churn
+    m._status = {"status": "ok", "epoch": 3}
+    m._repair_epoch()
+    assert calls == [2]           # a newer router owns it: leave it
+    m._status = {"status": "ok", "epoch": 0}
+    m.fenced = True
+    m._repair_epoch()
+    assert calls == [2]           # fenced members are not ours to claim
 
 
 # ---------------------------------------------------- subprocess e2e helpers
