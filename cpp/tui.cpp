@@ -394,6 +394,23 @@ struct Tui {
                     " throughput %.0f tok/s   MFU --   %s   %s   %s",
                     tok_rate > 0 ? tok_rate : 0.0, cache, degrade, schedc);
     out.push_back(std::string(CYAN) + l + RST);
+    /* Engine performance plane chip (its own line, present once the
+     * engine has dispatched or compiled): compile-ladder fill count +
+     * rolling step p99 off the always-on step profiler. A compile
+     * count still climbing in steady state is ladder thrash (the
+     * compile_storm alert's TUI face). */
+    auto sp = stats->get("stepprof");
+    if (sp && sp->type == mj::Value::OBJ) {
+      double comp =
+          sp->get("compiles") ? sp->get("compiles")->as_num() : 0;
+      auto sp99 = sp->get("p99_ms");
+      if (sp99 && sp99->type == mj::Value::NUM)
+        std::snprintf(l, sizeof l, " compiles %.0f · step p99 %.2fms",
+                      comp, sp99->as_num());
+      else
+        std::snprintf(l, sizeof l, " compiles %.0f · step p99 n/a", comp);
+      out.push_back(std::string(CYAN) + l + RST);
+    }
     /* Fleet replicas chip (only under a fleet router): N healthy / M
      * ejected / K draining. Red when any member is out of rotation —
      * capacity is reduced and streams may be mid-failover. */

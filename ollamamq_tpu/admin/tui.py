@@ -106,6 +106,18 @@ def _engine_stats_brief(engine) -> dict:
     }
     if sched is not None:
         out["sched"] = sched
+    # Engine performance plane chip (`compiles N · step p99 X ms`):
+    # compile-ladder count + rolling step p99 off the process-wide step
+    # profiler — absent until the first dispatch/compile, so the chips
+    # column stays quiet on an idle engine.
+    try:
+        from ollamamq_tpu.telemetry import stepprof
+
+        sp = stepprof.PROFILER.brief()
+        if sp is not None:
+            out["stepprof"] = sp
+    except Exception:
+        pass
     # Fleet replicas chip (N healthy / M ejected / K draining): present
     # only when the engine is a fleet router.
     fleet = getattr(engine, "fleet_counts", None)

@@ -19,6 +19,7 @@ from ollamamq_tpu.engine.engine import TPUEngine
 from ollamamq_tpu.engine.request import FinishReason, Request, StreamItem
 from ollamamq_tpu.engine.tokenizer import ByteTokenizer
 from ollamamq_tpu.telemetry import schema as tm
+from ollamamq_tpu.telemetry import stepprof
 
 log = logging.getLogger("ollamamq.fake")
 
@@ -111,6 +112,12 @@ class FakeRuntime:
         # so shedding/retry/watchdog paths are testable without jax.
         if self.fault_plan is not None:
             self.fault_plan.check("step")
+        # Step profiler, fake shape: admission is host_prep, the token-
+        # latency sleep is the "device dispatch", the emit loop is detok
+        # — so stepprof surfaces/tests run without jax. Idle ticks
+        # abandon the timer (no zero-sample flood).
+        _sp = stepprof.PROFILER.start("fake")
+        _gen0 = self.tokens_generated
         # Admission: slot-bounded so scheduling-policy order actually
         # decides WHO enters a contended batch (pre-policy the pop gate
         # alone bounded concurrency, so this gate never binds for fcfs
@@ -165,11 +172,11 @@ class FakeRuntime:
                            n_prompt=len(req.prompt_tokens))
                 self.active.append(req)
                 admitted.append(req)
+        real = sum(len(r.prompt_tokens) for r in admitted)
         if admitted:
             # Batch-compose record, fake shape: no padding (tokens are
             # words, not tensors), so real == padded — keeps the replay
             # harness's batch_stats/occupancy output meaningful.
-            real = sum(len(r.prompt_tokens) for r in admitted)
             self._jrec("batch", slots=[-1] * len(admitted),
                        reqs=[r.req_id for r in admitted],
                        batch_size=len(admitted), tokens=real,
@@ -178,8 +185,12 @@ class FakeRuntime:
                        pending=len(self.pending_prefill),
                        mode="fake", padded_tokens=real)
         self._tm_occupancy.set(len(self.active) / max(1, self.ecfg.max_slots))
+        _had_work = bool(admitted or self.active)
+        _n_decode = len(self.active)
+        _sp.mark("host_prep")
         if self.token_latency_s:
             time.sleep(self.token_latency_s)
+        _sp.mark("dispatch")
         for req in list(self.active):
             if req.cancelled.is_set():
                 self.active.remove(req)
@@ -240,6 +251,13 @@ class FakeRuntime:
                         req.stream.push(StreamItem("token", text=tail))
                     self._finish_served(req, core, FinishReason.LENGTH)
                     break
+        if _had_work:
+            _sp.mark("detok")
+            _sp.finish(T_pad=0, k_cap=0, n_prefill=len(admitted),
+                       n_decode=_n_decode,
+                       tokens=real + (self.tokens_generated - _gen0),
+                       padded_tokens=real + (self.tokens_generated - _gen0),
+                       compiled=False)
 
     # -- KV page migration (fake shape: no pages, just the word cursor) ----
     def export_request(self, rid: int):

@@ -30,6 +30,7 @@ import threading
 import time
 
 from ollamamq_tpu.telemetry import schema as tm
+from ollamamq_tpu.telemetry import stepprof
 from ollamamq_tpu.telemetry.attribution import phase_of
 
 log = logging.getLogger("ollamamq.health")
@@ -64,6 +65,17 @@ REGROUP_STORM_PER_MIN = 4.0
 # ollamamq_watchdog_stalls_total{kind="scale"}: a flapping scaler is a
 # watchdog-grade malfunction, not graceful degradation.
 SCALE_STORM_PER_MIN = 6.0
+# Compile-storm rule (engine performance plane): the compile ladder
+# front-loads its cost — every rung XLA-compiles exactly once during
+# warmup, then the jit caches serve steady state for free. Recompiles
+# still arriving at this rate past the warmup window mean the ladder is
+# broken (unbounded shape keys, pallas-probe thrash, an injected
+# `compile`-site eviction loop) and dispatches are paying seconds of
+# XLA wall each (alert "compile_storm", resolves when the rate drops).
+# Counts into ollamamq_watchdog_stalls_total{kind="compile"} like
+# scale_storm: a malfunction to tune out, not pressure to absorb.
+COMPILE_STORM_PER_MIN = 6.0
+COMPILE_WARMUP_S = 120.0
 # Router-HA rules (--ha primaries): a standby whose replication cursor
 # trails the primary by more than this many records — or that stopped
 # polling entirely — would lose that much admitted/progress state at
@@ -273,6 +285,7 @@ class HealthMonitor:
         self._check_preempt_storm()
         self._check_regroup_storm()
         self._check_scale_storm()
+        self._check_compile_storm()
         self._check_router_overhead()
         self._check_ha()
         self._check_journal_invariants()
@@ -368,6 +381,25 @@ class HealthMonitor:
             "is flapping fleet size (cooldown/sustain mis-tuned for "
             "this load); each flap costs a spawn or a drain + "
             "migrations", "scale")
+
+    def _check_compile_storm(self) -> None:
+        """Watchdog rule for compile-ladder thrash. Steady state compiles
+        NOTHING — each jit rung fills its cache exactly once during
+        warmup — so a recompile rate sustained past COMPILE_WARMUP_S
+        (module globals, monkeypatchable like the other thresholds)
+        means shape churn or a cache-eviction loop is taxing dispatches
+        with XLA wall time. Same _alert routing as scale_storm: a
+        control-plane malfunction, not graceful degradation."""
+        started = getattr(self.engine, "started_at", None)
+        if started is None or time.time() - started < COMPILE_WARMUP_S:
+            return  # ladder warmup: first-serve compiles are the design
+        rate = stepprof.PROFILER.compile_rate_per_min()
+        self._alert(
+            "compile_storm", rate > COMPILE_STORM_PER_MIN, "warn",
+            f"compile storm: {rate:.1f} jit recompiles/min past warmup — "
+            "the compile ladder is thrashing (shape churn or cache "
+            "eviction); every hit stalls its dispatch for the XLA wall",
+            "compile")
 
     def _check_router_overhead(self) -> None:
         """Overhead-storm rule (fleet routers only: the engine exposes

@@ -35,6 +35,7 @@ from ollamamq_tpu.engine.engine import QueueFullError
 from ollamamq_tpu.engine.request import FinishReason, Request, StreamItem
 from ollamamq_tpu.ops.sampling import SamplingParams
 from ollamamq_tpu.server.registry import ModelRegistry
+from ollamamq_tpu.telemetry import stepprof
 from ollamamq_tpu.server.templates import render_chat, template_owns_bos
 
 log = logging.getLogger("ollamamq.server")
@@ -172,6 +173,8 @@ class Server:
         r.add_route("GET", "/debug/requests/{req_id}", self.debug_request)
         r.add_route("GET", "/debug/bundle", self.debug_bundle)
         r.add_route("POST", "/debug/profile", self.debug_profile)
+        r.add_route("GET", "/debug/stepprof", self.debug_stepprof)
+        r.add_route("GET", "/debug/hbm", self.debug_hbm)
         r.add_route("GET", "/debug/prefix_cache", self.debug_prefix_cache)
         r.add_route("POST", "/debug/prefix_cache",
                     self.debug_prefix_cache_flush)
@@ -804,6 +807,11 @@ class Server:
         section("alerts", lambda: eng.alerts.to_dict())
         section("slo", lambda: eng.slo.summary())
         section("metrics", self._render_prometheus)
+        # Engine performance plane: step-phase/compile summary + the
+        # HBM timeline tail — the dispatch-level accounting an incident
+        # bundle needs next to the request timelines.
+        section("stepprof", lambda: stepprof.PROFILER.snapshot(64))
+        section("hbm", lambda: stepprof.PROFILER.hbm_tail(64))
         if getattr(eng, "tracer", None) is not None:
             from ollamamq_tpu.telemetry import attribution
 
@@ -1118,6 +1126,7 @@ class Server:
                 # later start_trace, wedging the endpoint permanently.
                 jax.profiler.stop_trace()
 
+        t_start = time.time()
         try:
             await asyncio.get_running_loop().run_in_executor(None, run_trace)
         except Exception as e:
@@ -1127,8 +1136,45 @@ class Server:
             raise ApiError(500, f"profile capture failed: {e}")
         finally:
             self._profiling = False
-        return web.json_response({"status": "success", "trace_dir": out_dir,
-                                  "seconds": seconds})
+        return web.json_response({
+            "status": "success", "trace_dir": out_dir, "seconds": seconds,
+            # The capture window's step accounting rides along: the
+            # stepprof ring slice taken while the device trace ran, so
+            # a trace and its per-phase step samples land together and
+            # a TensorBoard timeline can be read against the engine's
+            # own host_prep/dispatch/collect/detok attribution.
+            "stepprof": stepprof.PROFILER.window(t_start, time.time()),
+        })
+
+    async def debug_stepprof(self, request: web.Request) -> web.Response:
+        """Engine performance plane: the always-on step profiler's
+        bounded ring (telemetry/stepprof.py) — per-mode/per-phase
+        p50/p99, the per-shape (mode, T_pad, k_cap) latency table, the
+        compile-event ledger, and the profiler's own overhead meter.
+        `?n=` bounds the recent-samples/compile-events tails
+        (default 128)."""
+        self._ident(request)
+        try:
+            n = int(request.query.get("n", "128"))
+        except ValueError:
+            raise ApiError(400, "'n' must be an integer")
+        return web.json_response(stepprof.PROFILER.snapshot(max(1, n)))
+
+    async def debug_hbm(self, request: web.Request) -> web.Response:
+        """Allocator/HBM timeline: the sampled ring of per-runtime page-
+        pool state (free/used/cached/pool) and weight/KV byte footprints
+        over time — how headroom trends under load, and what an OOM
+        postmortem reads back. `?n=` bounds the tail."""
+        self._ident(request)
+        try:
+            n = int(request.query.get("n", "0"))
+        except ValueError:
+            raise ApiError(400, "'n' must be an integer")
+        eng = self.engine
+        return web.json_response({
+            "period_s": getattr(eng, "HBM_SAMPLE_PERIOD_S", None),
+            "timeline": stepprof.PROFILER.hbm_tail(n if n > 0 else None),
+        })
 
     # ------------------------------------------------------------- /api/*
     async def api_generate(self, request: web.Request) -> web.StreamResponse:

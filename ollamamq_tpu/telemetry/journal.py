@@ -111,6 +111,12 @@ EVENTS = (
     #                      done (serving, streams re-admitted) / aborted
     "epoch_fence",       # a stale-epoch router call was rejected — the
     #                      zombie-primary split-brain guard firing
+    # Engine performance plane (telemetry/stepprof.py).
+    "compile",           # a jit cache filled and the first call paid an
+    #                      XLA compile: which site/shape key, the wall
+    #                      ms the dispatch path stalled, the cache size
+    #                      after — exactly-once per ladder rung unless
+    #                      something is thrashing (compile_storm)
 )
 
 # kind -> (required fields, optional fields) beyond the common header
@@ -235,6 +241,10 @@ EVENT_FIELDS: Dict[str, Tuple[tuple, tuple]] = {
                          "replayed", "takeover_ms", "lag",
                          "members_claimed")),
     "epoch_fence": (("epoch", "stale_epoch"), ("path", "caller")),
+    # Compile events carry the shape key that missed, the wall ms the
+    # first call stalled compiling, and the cache size after the fill —
+    # enough to reconstruct the whole ladder from a journal tail.
+    "compile": (("site", "key", "wall_ms"), ("cache_size",)),
 }
 assert set(EVENT_FIELDS) == set(EVENTS)
 

@@ -355,6 +355,36 @@ RECOVERED_STREAMS_TOTAL = REGISTRY.counter(
     "with an explicit error, never a silent drop)",
     labels=("outcome",))
 
+# -- engine performance plane (telemetry/stepprof.py) ----------------------
+# Closed site vocabulary for ollamamq_compile_total{site}: one per jit
+# cache the engine fills (the compile ladder's rungs live in these).
+COMPILE_SITES = ("ragged", "prefill", "chunk", "sp_prefill", "decode",
+                 "embed")
+STEP_PHASE_MS = REGISTRY.histogram(
+    "ollamamq_step_phase_ms",
+    "Engine dispatch self-profiling: milliseconds each step spent per "
+    "phase (host_prep = python batch composition, dispatch = issuing "
+    "the jit'd computation — XLA compile on a fresh cache key, "
+    "collect = device wait + D2H materialization, detok = the host "
+    "emit loop), by step mode (ragged / spec_verify / decode / embed "
+    "/ fake) — the always-on stepprof ring's metric face",
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+             25.0, 50.0, 100.0, 250.0, 1000.0),
+    labels=("phase", "mode"))
+COMPILE_TOTAL = REGISTRY.counter(
+    "ollamamq_compile_total",
+    "XLA compiles the engine paid, by jit-cache site (ragged / prefill "
+    "/ chunk / sp_prefill / decode / embed) — exactly one per compile-"
+    "ladder rung in steady state; a climbing rate past warmup is a "
+    "ladder bug (compile_storm alert)", labels=("site",))
+COMPILE_MS = REGISTRY.histogram(
+    "ollamamq_compile_ms",
+    "Wall milliseconds one XLA compile held the dispatch path (the "
+    "first call of a fresh jit cache entry traces + compiles "
+    "synchronously; that call's wall IS the compile cost the step paid)",
+    buckets=(1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000,
+             30000, 60000, 120000))
+
 # -- host / device ---------------------------------------------------------
 HBM_USED_BYTES = REGISTRY.gauge(
     "ollamamq_hbm_used_bytes",

@@ -59,7 +59,14 @@ Each rule names ONE site and ONE trigger:
            observed heartbeat by delay_s — past the takeover grace the
            standby promotes — and "device_loss" keeps polls failing
            until heal_after_s, so a HEALED primary revives into a
-           promoted fleet: the revive-and-fence chaos case).
+           promoted fleet: the revive-and-fence chaos case), or the
+           engine's jit-cache seam ("compile", drawn in every
+           _get_*_jit getter when the key is already cached: ANY kind
+           fired evicts the cached entry so the next fill re-traces
+           and re-compiles — the injected recompile loop the
+           compile_storm health alert and the exactly-once compile-
+           event tests are driven by; the fault is the eviction
+           itself, so no exception is raised and no dispatch fails).
   kind     "exception"  -> the dispatch raises FaultInjected (the
                            engine's retry/containment path handles it);
            "slow"       -> the dispatch sleeps delay_s first (stall
@@ -96,7 +103,7 @@ from typing import Dict, List, Optional
 
 SITES = ("prefill", "chunk", "sp_prefill", "ragged", "spec_verify",
          "decode", "embed", "encode", "step", "alloc", "extend", "replica",
-         "migrate", "wal", "preempt", "router")
+         "migrate", "wal", "preempt", "router", "compile")
 KINDS = ("exception", "slow", "alloc_fail", "device_loss")
 
 _RULE_KEYS = {"site", "kind", "at", "every", "p", "times", "delay_s",

@@ -25,7 +25,13 @@
      GET /debug/trace/{rid}) must match the README router-span table
      (between the `<!-- router-spans:begin -->` /
      `<!-- router-spans:end -->` markers) exactly — same pattern as
-     phases.
+     phases;
+  6. stepprof phases — the step profiler's closed dispatch-phase
+     vocabulary (telemetry/stepprof.py PHASES: the `phase` label values
+     of `ollamamq_step_phase_ms`) must match the README "Engine
+     performance plane" phase table (between the
+     `<!-- stepprof-phases:begin -->` / `<!-- stepprof-phases:end -->`
+     markers) exactly.
 
 Imports ONLY ollamamq_tpu.telemetry.schema/.attribution/.journal/
 .tracing — the declaration sites — so the check runs without jax, a
@@ -51,6 +57,8 @@ JOURNAL_BEGIN = "<!-- journal-events:begin -->"
 JOURNAL_END = "<!-- journal-events:end -->"
 ROUTER_SPANS_BEGIN = "<!-- router-spans:begin -->"
 ROUTER_SPANS_END = "<!-- router-spans:end -->"
+STEPPROF_BEGIN = "<!-- stepprof-phases:begin -->"
+STEPPROF_END = "<!-- stepprof-phases:end -->"
 
 
 def documented_metric_names(readme_text: str) -> set:
@@ -134,6 +142,22 @@ def registered_router_spans() -> set:
     return set(ROUTER_EVENTS)
 
 
+def documented_stepprof_phases(readme_text: str) -> set:
+    """Backticked names inside the marked stepprof-phase region."""
+    start = readme_text.find(STEPPROF_BEGIN)
+    end = readme_text.find(STEPPROF_END)
+    if start == -1 or end == -1 or end < start:
+        return set()
+    return set(re.findall(r"`([a-z_]+)`", readme_text[start:end]))
+
+
+def registered_stepprof_phases() -> set:
+    sys.path.insert(0, _REPO)
+    from ollamamq_tpu.telemetry.stepprof import PHASES
+
+    return set(PHASES)
+
+
 def _diff(readme: str, what: str, registered: set, documented: set,
           missing_msg: str, ghost_msg: str) -> int:
     rc = 0
@@ -189,12 +213,20 @@ def main(argv) -> int:
         "router trace-span name(s) missing from the README router-span "
         f"table (between {ROUTER_SPANS_BEGIN} / {ROUTER_SPANS_END})",
         "documented router span(s) the router no longer emits")
+    rc |= _diff(
+        readme, "stepprof phases", registered_stepprof_phases(),
+        documented_stepprof_phases(text),
+        "step-profiler phase(s) missing from the README engine-"
+        f"performance-plane table (between {STEPPROF_BEGIN} / "
+        f"{STEPPROF_END})",
+        "documented stepprof phase(s) the step profiler no longer emits")
     if rc == 0:
         print(f"ok: {len(registered_metric_names())} metrics, "
               f"{len(registered_phase_names())} phases, "
               f"{len(registered_shed_reasons())} shed reasons, "
-              f"{len(registered_journal_events())} journal events, and "
-              f"{len(registered_router_spans())} router spans, "
+              f"{len(registered_journal_events())} journal events, "
+              f"{len(registered_router_spans())} router spans, and "
+              f"{len(registered_stepprof_phases())} stepprof phases, "
               "all documented")
     return rc
 

@@ -133,6 +133,8 @@ _MINIMAL = {
                             replayed=2, takeover_ms=812.5, lag=0),
     "epoch_fence": dict(epoch=3, stale_epoch=2, path="placement",
                         caller="router"),
+    "compile": dict(site="ragged", key="('ragged', 256, 0)",
+                    wall_ms=812.5, cache_size=3),
 }
 
 

@@ -85,3 +85,32 @@ def test_checker_pins_attribution_phase_table(tmp_path):
     bare.write_text(full.replace(mod.PHASES_BEGIN, "").replace(
         mod.PHASES_END, ""))
     assert mod.main(["check_metrics_docs.py", str(bare)]) == 1
+
+
+def test_checker_pins_stepprof_phase_table(tmp_path):
+    """Satellite (PR 20): the step profiler's closed dispatch-phase
+    vocabulary (telemetry/stepprof.py PHASES — the `phase` label of
+    `ollamamq_step_phase_ms`) is pinned to the README engine-
+    performance-plane table, same marker pattern as the others."""
+    mod = _load()
+    from ollamamq_tpu.telemetry.stepprof import PHASES
+
+    with open(os.path.join(_REPO, "README.md"), encoding="utf-8") as f:
+        full = f.read()
+    assert "| `host_prep` |" in full, "stepprof table row shape changed"
+    assert set(PHASES) == {"host_prep", "dispatch", "collect", "detok"}
+    # A documented phase row removed => missing-phase failure.
+    missing = tmp_path / "README_nostepphase.md"
+    missing.write_text(full.replace("| `host_prep` |", "| prep-less |", 1))
+    assert mod.main(["check_metrics_docs.py", str(missing)]) == 1
+    # A ghost phase inside the markers => ghost-phase failure.
+    ghost = tmp_path / "README_ghoststepphase.md"
+    ghost.write_text(full.replace(
+        mod.STEPPROF_END,
+        "| `notastepphase` | bogus |\n" + mod.STEPPROF_END, 1))
+    assert mod.main(["check_metrics_docs.py", str(ghost)]) == 1
+    # Markers stripped entirely => every phase reads as undocumented.
+    bare = tmp_path / "README_nostepmarkers.md"
+    bare.write_text(full.replace(mod.STEPPROF_BEGIN, "").replace(
+        mod.STEPPROF_END, ""))
+    assert mod.main(["check_metrics_docs.py", str(bare)]) == 1

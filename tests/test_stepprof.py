@@ -1,0 +1,371 @@
+"""Engine performance plane (PR 20): the always-on step profiler,
+compile-ladder observability, HBM timeline, and the bench regression
+sentinel.
+
+Contracts pinned here:
+
+  - every ring (samples / shape table / compile events / HBM timeline)
+    is bounded — always-on means O(1) memory forever;
+  - a sample's phase milliseconds sum to its recorded step wall clock,
+    and the instrumented wall covers >= 95% of the externally measured
+    dispatch wall on a REAL tiny runtime;
+  - compile events are exactly-once per (site, key) in steady state;
+    an injected `compile`-site fault (jit cache eviction loop) turns
+    the ladder into a storm and trips the health monitor's
+    compile_storm alert past warmup;
+  - the profiler survives injected dispatch faults: an abandoned step
+    leaves NO partial sample and the decision journal stays clean;
+  - profiler self-overhead stays under the 1% always-on budget;
+  - the fleet router federates member `ollamamq_step_phase_ms` series
+    with a replica label;
+  - scripts/bench_compare.py classifies the checked-in wedged rounds
+    as init-failed (exit 0) and exits non-zero on a synthetic >= 20%
+    regression.
+"""
+
+import glob
+import importlib.util
+import json
+import os
+import re
+import time
+
+import pytest
+
+from ollamamq_tpu.config import EngineConfig
+from ollamamq_tpu.ops.sampling import SamplingParams
+from ollamamq_tpu.telemetry import stepprof
+from ollamamq_tpu.telemetry.journal import check_invariants
+from ollamamq_tpu.telemetry.stepprof import (_COMPILE_RING, _HBM_RING,
+                                             _RING, _SHAPE_KEYS, PHASES,
+                                             PROFILER, StepProfiler)
+from ollamamq_tpu.testing.faults import FaultPlan
+from testutil import collect
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = dict(model="test-tiny", max_slots=2, num_pages=64, page_size=8,
+            max_pages_per_seq=16, prefill_buckets=(16, 32, 64),
+            decode_steps_per_iter=2)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_profiler():
+    PROFILER.reset()
+    yield
+    PROFILER.reset()
+
+
+def _tpu_engine(plan=None, **over):
+    import jax.numpy as jnp
+
+    from ollamamq_tpu.engine.engine import TPUEngine
+
+    cfg = dict(TINY)
+    cfg.update(over)
+    eng = TPUEngine(EngineConfig(fault_plan=plan, **cfg),
+                    models={"test-tiny": None}, blocklist_path=None,
+                    dtype=jnp.float32)
+    eng.start()
+    return eng
+
+
+def _run(eng, user, prompt="the quick brown fox jumps", max_tokens=8):
+    tok = eng.resolve_runtime("test-tiny").tokenizer
+    return eng.enqueue_request(
+        user, "", "test-tiny", prompt_tokens=tok.encode(prompt),
+        sampling=SamplingParams(max_tokens=max_tokens))
+
+
+def _phase_sum(sample):
+    return sum(sample[ph + "_ms"] for ph in PHASES)
+
+
+# ------------------------------------------------------------- boundedness
+def test_every_ring_is_bounded():
+    prof = StepProfiler()
+    for i in range(_RING + 500):
+        t = prof.start("ragged")
+        t.mark("host_prep")
+        t.finish(T_pad=(i % 100) * 8, k_cap=0, n_prefill=1, n_decode=0,
+                 tokens=4, padded_tokens=8, compiled=False)
+    for i in range(_COMPILE_RING + 50):
+        prof.record_compile("ragged", ("ragged", i), 1.0, i)
+    for i in range(_HBM_RING + 50):
+        prof.hbm_record({"models": {}})
+    assert len(prof.samples) == _RING
+    assert prof.seq == _RING + 500          # seq keeps counting past evict
+    assert len(prof._shapes) <= _SHAPE_KEYS
+    assert len(prof.compiles) == _COMPILE_RING
+    assert prof.compile_count() == _COMPILE_RING + 50
+    assert len(prof.hbm) == _HBM_RING
+    # Snapshot stays serializable and bounded too.
+    snap = prof.snapshot(n=64)
+    json.dumps(snap)
+    assert len(snap["recent"]) == 64
+    assert len(snap["shapes"]) <= _SHAPE_KEYS
+
+
+# -------------------------------------------------- phase sum == wall clock
+def test_phase_sum_matches_dispatch_wall_on_real_runtime():
+    """ACCEPTANCE: per-sample phase ms sum EXACTLY to the sample's step
+    wall (contiguous marks of one timer), and the instrumented wall
+    covers >= 95% of the externally measured step_ragged wall."""
+    eng = _tpu_engine()
+    rt = eng.runtimes["test-tiny"]
+    pairs = []  # (externally measured wall ms, the sample it produced)
+    orig = rt.step_ragged
+
+    def timed(core):
+        seq0 = PROFILER.seq
+        t0 = time.perf_counter()
+        ran = orig(core)
+        wall = (time.perf_counter() - t0) * 1e3
+        if PROFILER.seq > seq0:  # this step recorded exactly one sample
+            pairs.append((wall, PROFILER.tail(1)[0]))
+        return ran
+
+    rt.step_ragged = timed
+    try:
+        for i, u in enumerate(("alpha", "beta")):
+            items = collect(_run(eng, u, prompt="count to ten " * (i + 1)))
+            assert items[-1].kind == "done", items[-1].error
+    finally:
+        rt.step_ragged = orig
+        eng.stop()
+
+    assert pairs, "no ragged step samples were recorded"
+    for wall, s in pairs:
+        assert abs(_phase_sum(s) - s["total_ms"]) < 0.01, s
+        assert s["mode"] in ("ragged", "spec_verify")
+        assert s["tokens"] >= 0 and s["padded_tokens"] >= s["tokens"] >= 0
+    measured = sum(w for w, _ in pairs)
+    instrumented = sum(s["total_ms"] for _, s in pairs)
+    assert instrumented >= 0.95 * measured, \
+        f"instrumented {instrumented:.2f}ms < 95% of {measured:.2f}ms"
+    # Decode-scan samples carry the same arithmetic identity.
+    for s in PROFILER.tail():
+        assert abs(_phase_sum(s) - s["total_ms"]) < 0.01, s
+
+
+# ---------------------------------------------------------- compile ladder
+def test_compile_events_exactly_once_per_rung_then_steady_state():
+    eng = _tpu_engine()
+    try:
+        items = collect(_run(eng, "warm", prompt="short"))
+        assert items[-1].kind == "done", items[-1].error
+        items = collect(_run(eng, "warm2",
+                             prompt="a much longer prompt " * 4))
+        assert items[-1].kind == "done", items[-1].error
+        n_warm = PROFILER.compile_count()
+        assert n_warm > 0
+        events = list(PROFILER.compiles)
+        keys = [(e["site"], e["key"]) for e in events]
+        assert len(keys) == len(set(keys)), f"duplicate compiles: {keys}"
+        assert all(e["wall_ms"] > 0 for e in events)
+        # Every compile journals once, with the same key vocabulary.
+        jr = [r for r in eng.journal.tail(n=None) if r["kind"] == "compile"]
+        assert len(jr) == n_warm
+        assert {(r["site"], r["key"]) for r in jr} == set(keys)
+        # At least one step paid a compile and said so.
+        assert any(s.get("compiled") for s in PROFILER.tail())
+        # Steady state: an identical re-run compiles NOTHING.
+        items = collect(_run(eng, "steady", prompt="short"))
+        assert items[-1].kind == "done", items[-1].error
+        assert PROFILER.compile_count() == n_warm
+    finally:
+        eng.stop()
+
+
+def test_injected_recompile_loop_trips_compile_storm(monkeypatch):
+    """The faults.py `compile` site evicts cached jit entries, forcing a
+    re-trace on every revisit — the recompile loop the compile_storm
+    alert exists for. Warmup suppression, firing, and resolution all
+    exercised through the real HealthMonitor rule."""
+    from ollamamq_tpu.engine import health as health_mod
+    from ollamamq_tpu.engine.health import HealthMonitor
+    from ollamamq_tpu.telemetry import schema as tm
+
+    plan = FaultPlan([{"site": "compile", "kind": "exception", "every": 1}])
+    eng = _tpu_engine(plan=plan)
+    hm = HealthMonitor(eng, period_s=999.0)  # never started: driven by hand
+    try:
+        collect(_run(eng, "w1", prompt="storm me"))
+        n1 = PROFILER.compile_count()
+        collect(_run(eng, "w2", prompt="storm me"))
+        n2 = PROFILER.compile_count()
+        assert n2 > n1, "eviction fault did not force recompiles"
+        keys = [(e["site"], e["key"]) for e in PROFILER.compiles]
+        assert len(keys) > len(set(keys)), "no duplicate (site, key) pairs"
+        assert PROFILER.compile_rate_per_min() > 0
+
+        # Inside the warmup window the rule stays quiet by design.
+        monkeypatch.setattr(health_mod, "COMPILE_STORM_PER_MIN", 0.5)
+        hm._check_compile_storm()
+        assert "compile_storm" not in {a.name for a in eng.alerts.active()}
+
+        # Past warmup the same rate fires, counted under kind=compile.
+        monkeypatch.setattr(health_mod, "COMPILE_WARMUP_S", 0.0)
+        before = tm.WATCHDOG_STALLS_TOTAL.labels(kind="compile").value
+        hm._check_compile_storm()
+        assert "compile_storm" in {a.name for a in eng.alerts.active()}
+        assert tm.WATCHDOG_STALLS_TOTAL.labels(kind="compile").value \
+            == before + 1
+
+        # Storm over (events age out / ring reset) -> alert resolves.
+        PROFILER.reset()
+        hm._check_compile_storm()
+        assert "compile_storm" not in {a.name for a in eng.alerts.active()}
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------------- fault containment
+def test_profiler_survives_dispatch_faults_with_clean_journal():
+    """An injected ragged dispatch fault abandons that step's timer: no
+    partial sample lands in the ring (every recorded sample still sums
+    clean), the retried stream finishes, and the decision journal's
+    invariants hold."""
+    plan = FaultPlan([{"site": "ragged", "kind": "exception", "at": [1]}])
+    eng = _tpu_engine(plan=plan)
+    try:
+        items = collect(_run(eng, "faulty"))
+        assert items[-1].kind == "done", items[-1].error
+        samples = PROFILER.tail()
+        assert samples, "no samples after the retried dispatch"
+        for s in samples:
+            assert s["total_ms"] > 0
+            assert abs(_phase_sum(s) - s["total_ms"]) < 0.01, s
+        recs = eng.journal.tail(n=None)
+        assert not check_invariants(recs)
+    finally:
+        eng.stop()
+
+
+# ----------------------------------------------------------- self-overhead
+def test_self_overhead_stays_under_one_percent():
+    """ACCEPTANCE: always-on means the profiler's own clock reads and
+    ring appends must cost < 1% of the step wall it measures."""
+    eng = _tpu_engine()
+    try:
+        for u in ("o1", "o2"):
+            items = collect(_run(eng, u, max_tokens=10))
+            assert items[-1].kind == "done", items[-1].error
+    finally:
+        eng.stop()
+    frac = PROFILER.overhead_fraction()
+    assert PROFILER.seq > 0
+    assert 0.0 <= frac < 0.01, f"profiler overhead {frac:.4f} >= 1%"
+
+
+# -------------------------------------------------------------- federation
+def test_federation_exposes_per_replica_step_series():
+    """A fleet of real HTTP members federates their step-phase series
+    into the router's /metrics exposition with a replica label."""
+    from ollamamq_tpu.engine.fake import FakeEngine
+    from ollamamq_tpu.fleet import FleetRouter, HttpMember
+    from ollamamq_tpu.telemetry import REGISTRY
+    from test_fleet import TINY as FLEET_TINY
+    from test_fleet import _HttpBackend
+    from test_fleet import _run as _fleet_run
+    from test_fleet_obs import _wait
+
+    member_cfg = EngineConfig(**FLEET_TINY)
+    backends = [_HttpBackend(FakeEngine(member_cfg, blocklist_path=None))
+                for _ in range(2)]
+    for b in backends:
+        b.engine.start()
+    members = [HttpMember(f"h{i}", b.url, timeout_s=30, poll_period_s=0.1)
+               for i, b in enumerate(backends)]
+    router = FleetRouter(members, EngineConfig(**FLEET_TINY),
+                         blocklist_path=None, probe_period_s=0.05,
+                         eject_heartbeat_s=1.0, reprobe_backoff_s=0.1,
+                         evac_grace_s=0.5)
+    router.start()
+    try:
+        items = collect(_fleet_run(router, "fed-user"))
+        assert items[-1].kind == "done", items[-1].error
+        assert PROFILER.seq > 0, "fake member steps recorded no samples"
+
+        def federated_step_series():
+            fed = router.member_metric_federation()
+            if {name for name, _ in fed} != {"h0", "h1"}:
+                return False
+            text = REGISTRY.render(federated=fed)
+            return re.search(
+                r'^ollamamq_step_phase_ms[^\n]*replica="h[01]"',
+                text, re.M) is not None
+
+        _wait(federated_step_series, msg="federated step-phase series")
+    finally:
+        router.stop()
+        for b in backends:
+            b.stop()
+
+
+# ----------------------------------------------- capture-window cross-link
+def test_window_slices_ring_by_capture_timestamps():
+    """/debug/profile links its capture window to the stepprof ring by
+    timestamp: samples inside [t0, t1] are returned, others are not."""
+    t_before = time.time()
+    t = PROFILER.start("fake")
+    t.mark("dispatch")
+    t.finish(T_pad=0, k_cap=0, n_prefill=0, n_decode=1, tokens=1,
+             padded_tokens=1, compiled=False)
+    t_after = time.time()
+    inside = PROFILER.window(t_before, t_after)
+    assert len(inside) == 1 and inside[0]["mode"] == "fake"
+    assert PROFILER.window(t_after + 10, t_after + 20) == []
+    assert PROFILER.window(t_before - 20, t_before - 10) == []
+
+
+# -------------------------------------------------------- bench_compare CI
+def _load_bench_compare():
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare", os.path.join(_REPO, "scripts", "bench_compare.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_compare_flags_wedged_history_as_init_failed():
+    """ACCEPTANCE: the checked-in BENCH_r*.json trajectory (every round
+    died at device init) classifies as init-failed — environment
+    casualties, NOT regressions — and the sentinel exits 0."""
+    mod = _load_bench_compare()
+    files = sorted(glob.glob(os.path.join(_REPO, "BENCH_r*.json")))
+    assert files, "checked-in bench history missing"
+    for path in files:
+        assert mod.classify(mod.load_round(path)) == "init-failed", path
+    assert mod.main(files) == 0
+
+
+def test_bench_compare_detects_synthetic_regressions(tmp_path):
+    def write(n, value, p99):
+        rec = {"n": n, "cmd": "bench", "rc": 0, "tail": "", "parsed": {
+            "metric": "decode_tok_per_s_per_chip", "value": value,
+            "step_profile": {"modes": {"decode": {
+                "step": {"n": 10, "p50_ms": p99 / 2, "p99_ms": p99}}}}}}
+        path = tmp_path / f"BENCH_r{n:02d}.json"
+        path.write_text(json.dumps(rec))
+        return str(path)
+
+    mod = _load_bench_compare()
+    # >= 20% tok/s drop => exit 2.
+    a, b = write(1, 1000.0, 10.0), write(2, 750.0, 10.0)
+    assert mod.main([a, b]) == 2
+    # Step-p99 blowup with flat tok/s => still a regression.
+    b2 = write(3, 990.0, 25.0)
+    assert mod.main([a, b2]) == 2
+    # Small drift under the threshold => clean exit.
+    b3 = write(4, 950.0, 10.5)
+    assert mod.main([a, b3]) == 0
+    # A wedged round interleaved in the trajectory is skipped, and the
+    # comparable neighbours still diff against each other.
+    wedged = tmp_path / "BENCH_r05.json"
+    wedged.write_text(json.dumps({
+        "n": 5, "cmd": "bench", "rc": 3, "tail": "", "parsed": {
+            "metric": "decode_tok_per_s_per_chip", "value": 0.0,
+            "error": "device/runtime init exceeded 300s", "phase": "init"}}))
+    assert mod.main([a, str(wedged)]) == 0
+    assert mod.main([a, str(wedged), b]) == 2

@@ -382,6 +382,41 @@ def test_tui_ha_role_chip_via_pty(tmp_path):
         t.close()
 
 
+# Engine stub with a seeded step profiler: the performance-plane chip
+# (`compiles N · step p99 X ms`) reads the process-wide PROFILER, so
+# the child seeds it with a deterministic sample + two compile events.
+_CHILD_STEPPROF = _CHILD.replace(
+    'eng.runtimes = {}\nadmin_tui.run_tui(eng, None, refresh_ms=50)',
+    '''eng.runtimes = {}
+from ollamamq_tpu.telemetry import stepprof
+stepprof.PROFILER.reset()
+tmr = stepprof.PROFILER.start("decode")
+tmr.mark("dispatch")
+tmr.phases["dispatch"] = 12.34     # pin the rendered p99 exactly
+tmr._last = tmr._t0 + 0.01234
+tmr.finish(T_pad=0, k_cap=2, n_prefill=0, n_decode=1, tokens=2,
+           padded_tokens=4, compiled=True)
+stepprof.PROFILER.record_compile("decode", "(2,)", 100.0, 1)
+stepprof.PROFILER.record_compile("ragged", "(16,)", 200.0, 2)
+admin_tui.run_tui(eng, None, refresh_ms=50)''')
+assert _CHILD_STEPPROF != _CHILD, "stepprof child patch failed to apply"
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="pty/termios test")
+def test_tui_stepprof_chip_via_pty(tmp_path):
+    """Engine-performance-plane TUI: the chips panel renders the compile
+    count and rolling step p99 off the step profiler's brief()."""
+    t = _PtyTui(tmp_path, child_src=_CHILD_STEPPROF)
+    try:
+        assert t.wait_output(b"compiles 2"), _stderr(t)
+        assert t.wait_output(b"step p99 12.34ms"), _stderr(t)
+        t.send("q")
+        assert t.wait_output(b"TUI_EXIT_OK"), _stderr(t)
+        assert t.proc.wait(timeout=30) == 0
+    finally:
+        t.close()
+
+
 @pytest.mark.skipif(sys.platform != "linux", reason="pty/termios test")
 def test_tui_no_alerts_renders_quiet_panel(tmp_path):
     """Without an alert table (or with it empty) the ALERTS section still
